@@ -1,0 +1,16 @@
+"""tpu-gbdt: a TPU-native gradient-boosting framework with the capabilities
+of LightGBM 2.2.4 (reference layout: `python-package/lightgbm/__init__.py`).
+
+Compute path is JAX/XLA/Pallas: the binned dataset lives in HBM, per-leaf
+histograms are built by MXU one-hot contractions / Pallas kernels, split
+finding is a vectorized scan over bins, and the distributed tree learners run
+XLA collectives over a `jax.sharding.Mesh`.
+"""
+from .config import Config
+from .io.dataset import Dataset as _RawDataset
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+]

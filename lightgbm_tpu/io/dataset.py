@@ -1,0 +1,376 @@
+"""Binned dataset container.
+
+Re-creates the reference `Dataset` / `Metadata` / `DatasetLoader` roles
+(`src/io/dataset.cpp`, `src/io/metadata.cpp`, `src/io/dataset_loader.cpp`) in a
+TPU-first layout: instead of per-feature-group `Bin` objects with scatter-add
+hot loops, the binned matrix is one dense `uint8[num_data, num_features]`
+array destined for HBM, and histogramming is a batched one-hot contraction
+(see `ops/histogram.py`).
+
+Host-side responsibilities kept here: sampling for bin finding
+(`DatasetLoader::SampleTextDataFromMemory`), per-feature BinMapper
+construction (distributed bin-finding allgather seam included), metadata
+(label/weight/query/init_score, `src/io/metadata.cpp`), and binary
+save/load (`Dataset::SaveBinaryFile`, `dataset_loader.cpp:268`).
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                      MISSING_NONE, MISSING_ZERO, BinMapper)
+
+_BINARY_MAGIC = b"tpu_gbdt_dataset_v1\n"
+
+_MISSING_CODE = {MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}
+_BINTYPE_CODE = {BIN_NUMERICAL: 0, BIN_CATEGORICAL: 1}
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference `src/io/metadata.cpp`, `dataset.h:40-249`)."""
+
+    def __init__(self, num_data: int) -> None:
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        arr = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            raise ValueError(
+                f"label length {len(arr)} != num_data {self.num_data}")
+        self.label = arr
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        arr = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            raise ValueError(
+                f"weight length {len(arr)} != num_data {self.num_data}")
+        self.weight = arr
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """Accepts group sizes (LightGBM convention) or query boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        arr = np.asarray(group, dtype=np.int64).reshape(-1)
+        if arr.sum() == self.num_data:
+            self.query_boundaries = np.concatenate(
+                [[0], np.cumsum(arr)]).astype(np.int64)
+        elif len(arr) > 0 and arr[0] == 0 and arr[-1] == self.num_data:
+            self.query_boundaries = arr
+        else:
+            raise ValueError("group sizes do not sum to num_data")
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        arr = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        if len(arr) % self.num_data != 0:
+            raise ValueError("init_score length must be a multiple of num_data")
+        self.init_score = arr
+
+    @property
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """Host-side binned dataset (reference `Dataset`, `dataset.h:250+`).
+
+    Attributes
+    ----------
+    bins : np.ndarray uint8/uint16 [num_data, num_used_features]
+        Binned matrix, feature-minor. Uploaded once to HBM by the learner.
+    mappers : list[BinMapper]
+        One per ORIGINAL feature column (trivial features have
+        ``is_trivial=True`` and no column in ``bins``).
+    used_feature_map : np.ndarray int32 [num_total_features]
+        original feature -> column in bins, or -1 if unused
+        (reference ``used_feature_map_``).
+    """
+
+    def __init__(self) -> None:
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bins: Optional[np.ndarray] = None
+        self.mappers: List[BinMapper] = []
+        self.used_feature_map: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.real_feature_idx: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.feature_names: List[str] = []
+        self.metadata: Metadata = Metadata(0)
+        self.max_bin: int = 255
+        self.min_data_in_bin: int = 3
+        self.use_missing: bool = True
+        self.zero_as_missing: bool = False
+        self.monotone_constraints: np.ndarray = np.zeros(0, dtype=np.int8)
+        self.feature_penalty: np.ndarray = np.zeros(0, dtype=np.float64)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        """Number of used (non-trivial) features."""
+        return 0 if self.bins is None else self.bins.shape[1]
+
+    def feature_num_bin(self, sub_feature: int) -> int:
+        return self.mappers[self.real_feature_idx[sub_feature]].num_bin
+
+    def used_mappers(self) -> List[BinMapper]:
+        return [self.mappers[i] for i in self.real_feature_idx]
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, label: Optional[Sequence] = None,
+                    config: Optional[Config] = None,
+                    weight: Optional[Sequence] = None,
+                    group: Optional[Sequence] = None,
+                    init_score: Optional[Sequence] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_feature: Optional[Sequence[int]] = None,
+                    reference: Optional["Dataset"] = None) -> "Dataset":
+        """Build a binned dataset from a dense float matrix (the analogue of
+        `LGBM_DatasetCreateFromMat` -> `CostructFromSampleData`,
+        `src/c_api.cpp` / `dataset_loader.cpp:535`).
+
+        When `reference` is given, reuse its bin mappers so validation data
+        aligns with the training set (reference
+        `LoadFromFileAlignWithOtherDataset`, `dataset_loader.cpp:224`).
+        """
+        cfg = config or Config()
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim != 2:
+            raise ValueError("data must be 2-D")
+        n, f = data.shape
+        self = cls()
+        self.num_data = n
+        self.num_total_features = f
+        self.metadata = Metadata(n)
+        self.max_bin = cfg.max_bin
+        self.min_data_in_bin = cfg.min_data_in_bin
+        self.use_missing = cfg.use_missing
+        self.zero_as_missing = cfg.zero_as_missing
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(f)])
+
+        cat_set = set(int(c) for c in (categorical_feature or []))
+        if cfg.categorical_feature:
+            for tok in str(cfg.categorical_feature).split(","):
+                tok = tok.strip()
+                if tok.startswith("name:"):
+                    continue
+                if tok:
+                    cat_set.add(int(tok))
+
+        if reference is not None:
+            self.mappers = reference.mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_feature_idx = reference.real_feature_idx
+            self.max_bin = reference.max_bin
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+            self.feature_names = reference.feature_names
+        else:
+            # --- sample rows for bin finding (reference
+            #     bin_construct_sample_cnt, dataset_loader.cpp:162+)
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_cnt = min(n, max(cfg.bin_construct_sample_cnt, 1))
+            if sample_cnt < n:
+                sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+                sample = data[sample_idx]
+            else:
+                sample = data
+            self.mappers = []
+            for j in range(f):
+                col = sample[:, j]
+                # keep only non-zero entries; zeros are implied by count
+                nonzero = col[~((col >= -1e-35) & (col <= 1e-35))]
+                m = BinMapper()
+                bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+                m.find_bin(nonzero, total_sample_cnt=len(col),
+                           max_bin=cfg.max_bin,
+                           min_data_in_bin=cfg.min_data_in_bin,
+                           min_split_data=cfg.min_data_in_leaf,
+                           bin_type=bt, use_missing=cfg.use_missing,
+                           zero_as_missing=cfg.zero_as_missing)
+                self.mappers.append(m)
+            self.used_feature_map = np.full(f, -1, dtype=np.int32)
+            used = [j for j in range(f) if not self.mappers[j].is_trivial]
+            for col_idx, j in enumerate(used):
+                self.used_feature_map[j] = col_idx
+            self.real_feature_idx = np.asarray(used, dtype=np.int32)
+            # monotone constraints / feature penalties follow original index
+            mono = np.zeros(f, dtype=np.int8)
+            for i, v in enumerate(cfg.monotone_constraints[:f]):
+                mono[i] = np.int8(v)
+            self.monotone_constraints = mono[self.real_feature_idx] \
+                if len(used) else np.zeros(0, dtype=np.int8)
+            pen = np.ones(f, dtype=np.float64)
+            for i, v in enumerate(cfg.feature_contri[:f]):
+                pen[i] = float(v)
+            self.feature_penalty = pen[self.real_feature_idx] \
+                if len(used) else np.zeros(0, dtype=np.float64)
+
+        # --- full binned ingest
+        used = self.real_feature_idx
+        max_nb = max((self.mappers[j].num_bin for j in used), default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        bins = np.empty((n, len(used)), dtype=dtype)
+        for col_idx, j in enumerate(used):
+            bins[:, col_idx] = self.mappers[j].values_to_bins(
+                data[:, j]).astype(dtype)
+        self.bins = bins
+
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    # ------------------------------------------------------------------
+    def subset(self, row_indices: np.ndarray) -> "Dataset":
+        """Row subset sharing bin mappers (reference `Dataset::CopySubset`,
+        used by `lgb.cv` fold construction)."""
+        idx = np.asarray(row_indices, dtype=np.int64)
+        out = Dataset()
+        out.num_data = len(idx)
+        out.num_total_features = self.num_total_features
+        out.bins = None if self.bins is None else self.bins[idx]
+        out.mappers = self.mappers
+        out.used_feature_map = self.used_feature_map
+        out.real_feature_idx = self.real_feature_idx
+        out.feature_names = self.feature_names
+        out.max_bin = self.max_bin
+        out.min_data_in_bin = self.min_data_in_bin
+        out.use_missing = self.use_missing
+        out.zero_as_missing = self.zero_as_missing
+        out.monotone_constraints = self.monotone_constraints
+        out.feature_penalty = self.feature_penalty
+        out.metadata = Metadata(len(idx))
+        if self.metadata.label is not None:
+            out.metadata.label = self.metadata.label[idx]
+        if self.metadata.weight is not None:
+            out.metadata.weight = self.metadata.weight[idx]
+        if self.metadata.init_score is not None:
+            ns = len(self.metadata.init_score) // self.num_data
+            out.metadata.init_score = self.metadata.init_score.reshape(
+                ns, self.num_data)[:, idx].reshape(-1)
+        # query boundaries cannot survive arbitrary subsetting; only keep if
+        # the subset respects query blocks
+        return out
+
+    # ------------------------------------------------------------------
+    # binary serialization (reference Dataset::SaveBinaryFile /
+    # DatasetLoader::LoadFromBinFile)
+    def save_binary(self, path: str) -> None:
+        header = {
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "max_bin": self.max_bin,
+            "min_data_in_bin": self.min_data_in_bin,
+            "use_missing": self.use_missing,
+            "zero_as_missing": self.zero_as_missing,
+            "feature_names": self.feature_names,
+            "used_feature_map": self.used_feature_map.tolist(),
+            "real_feature_idx": self.real_feature_idx.tolist(),
+            "monotone": self.monotone_constraints.tolist(),
+            "penalty": self.feature_penalty.tolist(),
+            "mappers": [m.to_dict() for m in self.mappers],
+            "bins_dtype": str(self.bins.dtype) if self.bins is not None else "",
+            "has_label": self.metadata.label is not None,
+            "has_weight": self.metadata.weight is not None,
+            "has_query": self.metadata.query_boundaries is not None,
+            "has_init_score": self.metadata.init_score is not None,
+        }
+        with open(path, "wb") as fh:
+            fh.write(_BINARY_MAGIC)
+            hb = json.dumps(header).encode()
+            fh.write(struct.pack("<q", len(hb)))
+            fh.write(hb)
+            if self.bins is not None:
+                np.save(fh, self.bins, allow_pickle=False)
+            for arr in (self.metadata.label, self.metadata.weight,
+                        self.metadata.query_boundaries,
+                        self.metadata.init_score):
+                if arr is not None:
+                    np.save(fh, arr, allow_pickle=False)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "Dataset":
+        with open(path, "rb") as fh:
+            magic = fh.read(len(_BINARY_MAGIC))
+            if magic != _BINARY_MAGIC:
+                raise ValueError(f"{path} is not a tpu_gbdt binary dataset")
+            (hlen,) = struct.unpack("<q", fh.read(8))
+            header = json.loads(fh.read(hlen).decode())
+            self = cls()
+            self.num_data = header["num_data"]
+            self.num_total_features = header["num_total_features"]
+            self.max_bin = header["max_bin"]
+            self.min_data_in_bin = header["min_data_in_bin"]
+            self.use_missing = header["use_missing"]
+            self.zero_as_missing = header["zero_as_missing"]
+            self.feature_names = header["feature_names"]
+            self.used_feature_map = np.asarray(header["used_feature_map"],
+                                               dtype=np.int32)
+            self.real_feature_idx = np.asarray(header["real_feature_idx"],
+                                               dtype=np.int32)
+            self.monotone_constraints = np.asarray(header["monotone"],
+                                                   dtype=np.int8)
+            self.feature_penalty = np.asarray(header["penalty"])
+            self.mappers = [BinMapper.from_dict(d) for d in header["mappers"]]
+            self.metadata = Metadata(self.num_data)
+            if header["bins_dtype"]:
+                self.bins = np.load(fh, allow_pickle=False)
+            if header["has_label"]:
+                self.metadata.label = np.load(fh, allow_pickle=False)
+            if header["has_weight"]:
+                self.metadata.weight = np.load(fh, allow_pickle=False)
+            if header["has_query"]:
+                self.metadata.query_boundaries = np.load(fh, allow_pickle=False)
+            if header["has_init_score"]:
+                self.metadata.init_score = np.load(fh, allow_pickle=False)
+        return self
+
+    # ------------------------------------------------------------------
+    def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
+        """Per-used-feature metadata arrays consumed by the device split
+        finder (`ops/split.py`)."""
+        ms = self.used_mappers()
+        fcount = len(ms)
+        num_bin = np.asarray([m.num_bin for m in ms], dtype=np.int32)
+        default_bin = np.asarray([m.default_bin for m in ms], dtype=np.int32)
+        missing = np.asarray([_MISSING_CODE[m.missing_type] for m in ms],
+                             dtype=np.int32)
+        bin_type = np.asarray([_BINTYPE_CODE[m.bin_type] for m in ms],
+                              dtype=np.int32)
+        mono = (self.monotone_constraints.astype(np.int32)
+                if len(self.monotone_constraints) == fcount
+                else np.zeros(fcount, dtype=np.int32))
+        penalty = (self.feature_penalty.astype(np.float32)
+                   if len(self.feature_penalty) == fcount
+                   else np.ones(fcount, dtype=np.float32))
+        return {
+            "num_bin": num_bin,
+            "default_bin": default_bin,
+            "missing_type": missing,
+            "bin_type": bin_type,
+            "monotone": mono,
+            "penalty": penalty,
+        }

@@ -1,0 +1,10 @@
+"""Test harness: force an 8-device virtual CPU mesh so distributed learners
+are exercised without real multi-chip hardware (SURVEY.md §4: the TPU analogue
+of the reference's localhost-socket multi-rank trick)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

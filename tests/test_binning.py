@@ -1,0 +1,166 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config, parse_config_file
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, BIN_NUMERICAL,
+                                     MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                                     BinMapper)
+from lightgbm_tpu.io.dataset import Dataset
+
+
+def test_config_aliases():
+    cfg = Config.from_params({
+        "num_boost_round": 50, "eta": "0.05", "num_leaf": 63,
+        "min_child_samples": 5, "sub_row": 0.8, "colsample_bytree": 0.7,
+        "boosting_type": "gbrt", "application": "softmax",
+        "device": "gpu", "metrics": "rmse,auc", "random_state": 7,
+    })
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.05
+    assert cfg.num_leaves == 63
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.bagging_fraction == 0.8
+    assert cfg.feature_fraction == 0.7
+    assert cfg.boosting == "gbdt"
+    assert cfg.objective == "multiclass"
+    assert cfg.device_type == "tpu"
+    assert cfg.metric == ["rmse", "auc"]
+    assert cfg.seed == 7
+
+
+def test_config_file_parse():
+    text = """
+    # comment
+    task = train
+    objective = binary
+    num_trees = 10  # trailing comment
+    learning_rate=0.2
+    """
+    params = parse_config_file(text)
+    cfg = Config.from_params(params)
+    assert cfg.task == "train"
+    assert cfg.objective == "binary"
+    assert cfg.num_iterations == 10
+    assert cfg.learning_rate == 0.2
+
+
+def test_numerical_binning_basic():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = BinMapper().find_bin(vals, total_sample_cnt=len(vals), max_bin=255)
+    assert not m.is_trivial
+    assert 2 <= m.num_bin <= 255
+    assert m.missing_type == MISSING_NONE
+    bins = m.values_to_bins(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # monotone: larger values get larger-or-equal bins
+    order = np.argsort(vals)
+    assert np.all(np.diff(bins[order]) >= 0)
+    # each value maps into the first bound >= value
+    for v in [-2.0, -0.5, 0.0, 0.3, 1.7]:
+        b = m.value_to_bin(v)
+        assert v <= m.bin_upper_bound[b]
+        if b > 0:
+            assert v > m.bin_upper_bound[b - 1]
+
+
+def test_binning_few_distinct():
+    vals = np.repeat([1.0, 2.0, 3.0, 5.0], 100)
+    m = BinMapper().find_bin(vals, total_sample_cnt=len(vals), max_bin=255,
+                             min_data_in_bin=3)
+    assert m.num_bin == 5  # 4 distinct plus the implied zero bin
+    assert m.value_to_bin(1.0) != m.value_to_bin(2.0)
+    assert m.value_to_bin(0.0) == 0
+
+
+def test_binning_nan_missing():
+    vals = np.concatenate([np.random.RandomState(1).rand(1000) + 1.0,
+                           [np.nan] * 50])
+    m = BinMapper().find_bin(vals, total_sample_cnt=len(vals), max_bin=63,
+                             use_missing=True, zero_as_missing=False)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    assert m.value_to_bin(1.5) < m.num_bin - 1
+
+
+def test_binning_zero_as_missing():
+    vals = np.random.RandomState(2).rand(500) + 0.5
+    m = BinMapper().find_bin(vals, total_sample_cnt=1000, max_bin=63,
+                             use_missing=True, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    # NaN maps to the zero (default) bin under zero-as-missing
+    assert m.value_to_bin(np.nan) == m.value_to_bin(0.0)
+
+
+def test_binning_trivial_constant():
+    vals = np.zeros(0)  # all values are zero -> no non-zero samples
+    m = BinMapper().find_bin(vals, total_sample_cnt=1000, max_bin=255)
+    assert m.is_trivial
+
+
+def test_categorical_binning():
+    rng = np.random.RandomState(3)
+    cats = rng.choice([0, 1, 2, 3, 10], size=2000,
+                      p=[0.4, 0.3, 0.2, 0.05, 0.05]).astype(float)
+    nonzero = cats[cats != 0]
+    m = BinMapper().find_bin(nonzero, total_sample_cnt=len(cats), max_bin=255,
+                             bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    assert not m.is_trivial
+    assert m.default_bin == m.value_to_bin(0.0)
+    assert m.default_bin > 0  # bin 0 must not be category 0
+    # most frequent non-zero category gets bin 0
+    assert m.bin_2_categorical[0] == 1
+    # distinct categories map to distinct bins
+    bins = {c: m.value_to_bin(float(c)) for c in [0, 1, 2, 3, 10]}
+    assert len(set(bins.values())) == 5
+    # unseen category maps to last bin
+    assert m.value_to_bin(999.0) == m.num_bin - 1
+
+
+def test_dataset_from_matrix():
+    rng = np.random.RandomState(4)
+    X = rng.randn(500, 10)
+    X[:, 3] = 0.0  # trivial feature
+    X[:, 7] = rng.choice([0, 1, 2], size=500)
+    y = rng.rand(500)
+    ds = Dataset.from_matrix(X, label=y, config=Config(),
+                             categorical_feature=[7])
+    assert ds.num_data == 500
+    assert ds.num_total_features == 10
+    assert ds.num_features == 9  # trivial feature dropped
+    assert ds.used_feature_map[3] == -1
+    assert ds.bins.dtype == np.uint8
+    assert ds.metadata.label is not None
+    meta = ds.feature_meta_arrays()
+    assert meta["num_bin"].shape == (9,)
+    assert meta["bin_type"][ds.used_feature_map[7]] == 1
+
+
+def test_dataset_reference_alignment(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.randn(300, 5)
+    Xv = rng.randn(100, 5)
+    ds = Dataset.from_matrix(X, label=rng.rand(300))
+    dv = Dataset.from_matrix(Xv, label=rng.rand(100), reference=ds)
+    assert dv.mappers is ds.mappers
+    # same values map to same bins in both datasets
+    col = ds.mappers[0].values_to_bins(Xv[:, 0])
+    assert np.array_equal(dv.bins[:, 0], col.astype(dv.bins.dtype))
+    # binary round-trip
+    p = tmp_path / "ds.bin"
+    ds.save_binary(str(p))
+    ds2 = Dataset.load_binary(str(p))
+    assert ds2.num_data == ds.num_data
+    assert np.array_equal(ds2.bins, ds.bins)
+    assert np.allclose(ds2.metadata.label, ds.metadata.label)
+    assert ds2.mappers[0].num_bin == ds.mappers[0].num_bin
+    assert np.array_equal(ds2.mappers[0].bin_upper_bound,
+                          ds.mappers[0].bin_upper_bound)
+
+
+def test_query_metadata():
+    ds = Dataset.from_matrix(np.random.rand(100, 3), label=np.random.rand(100),
+                             group=[30, 30, 40])
+    assert ds.metadata.num_queries == 3
+    assert ds.metadata.query_boundaries.tolist() == [0, 30, 60, 100]
