@@ -1,0 +1,101 @@
+"""Leaf partition of the training rows.
+
+Re-creates the reference `DataPartition` (`src/treelearner/data_partition.hpp`)
++ `DenseBin::Split` routing (`src/io/dense_bin.hpp:195-255`): a permuted
+row-index array where each leaf's rows are contiguous, with host-side
+(begin, count) bookkeeping. The split is a stable two-way partition done on
+device via a 3-key stable argsort, so rows belonging to other leaves inside
+the padded slice keep their position.
+
+Routing semantics (unpacked single-feature bins; reference offsets/bias
+collapse away):
+- numerical, missing None : bin <= threshold -> left
+- numerical, missing Zero : bin == default_bin -> default side; else <= thr
+- numerical, missing NaN  : bin == num_bin-1 (NaN bin) -> default side;
+                            else <= thr
+- categorical             : bin in threshold-set -> left (bitset,
+                            `SplitCategorical`, dense_bin.hpp:256-283)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MISSING_NONE_C, MISSING_ZERO_C, MISSING_NAN_C = 0, 1, 2
+
+
+def numerical_goes_left(binvals: jax.Array, threshold, default_left,
+                        missing_type, default_bin, num_bin) -> jax.Array:
+    base = binvals <= threshold
+    is_default = jnp.where(
+        missing_type == MISSING_ZERO_C, binvals == default_bin,
+        jnp.where(missing_type == MISSING_NAN_C, binvals == num_bin - 1,
+                  False))
+    return jnp.where(is_default, default_left, base)
+
+
+def categorical_goes_left(binvals: jax.Array, bitset: jax.Array) -> jax.Array:
+    """bitset: uint32[words]; left iff bit `bin` set (reference
+    Common::FindInBitset, utils/common.h)."""
+    word = (binvals >> 5).astype(jnp.int32)
+    bit = (binvals & 31).astype(jnp.uint32)
+    w = bitset[jnp.clip(word, 0, bitset.shape[0] - 1)]
+    hit = ((w >> bit) & jnp.uint32(1)) != 0
+    return hit & (word < bitset.shape[0])
+
+
+@functools.partial(jax.jit, static_argnames=("padded",))
+def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
+                    count: jax.Array, padded: int, threshold: jax.Array,
+                    default_left: jax.Array, missing_type: jax.Array,
+                    default_bin: jax.Array, num_bin: jax.Array,
+                    is_categorical: jax.Array,
+                    cat_bitset: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Stable-partition one leaf's slice of the global index array.
+
+    indices:  int32 [N_pad] permuted row ids (leaf rows contiguous)
+    bins_col: uint8/int32 [N] the split feature's bin column
+    begin/count: dynamic scalars; padded: static slice length >= count
+    cat_bitset: uint32[8] (covers 256 bins) — ignored for numerical
+
+    Returns (new_indices, left_count).
+    """
+    idx = lax.dynamic_slice(indices, (begin,), (padded,))
+    pos = jnp.arange(padded, dtype=jnp.int32)
+    valid = pos < count
+    safe = jnp.where(valid, idx, 0)
+    b = bins_col[safe].astype(jnp.int32)
+    gl_num = numerical_goes_left(b, threshold, default_left, missing_type,
+                                 default_bin, num_bin)
+    gl_cat = categorical_goes_left(b, cat_bitset)
+    goes_left = jnp.where(is_categorical, gl_cat, gl_num)
+    # stable 3-key sort: left rows (0), right rows (1), out-of-leaf tail (2)
+    key = jnp.where(valid, jnp.where(goes_left, 0, 1), 2).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    new_slice = idx[order]
+    left_count = jnp.sum((key == 0).astype(jnp.int32))
+    new_indices = lax.dynamic_update_slice(indices, new_slice, (begin,))
+    return new_indices, left_count
+
+
+@functools.partial(jax.jit, static_argnames=("n", "n_pad"))
+def init_partition(n: int, n_pad: int) -> jax.Array:
+    """Root partition: identity permutation padded with sentinel n."""
+    idx = jnp.arange(n_pad, dtype=jnp.int32)
+    return jnp.where(idx < n, idx, n - 1)
+
+
+def init_partition_from(indices, n_pad: int) -> jax.Array:
+    """Root partition from a bagging subset (reference
+    `DataPartition::Init` with used_indices, data_partition.hpp:59)."""
+    idx = jnp.asarray(indices, jnp.int32)
+    n = idx.shape[0]
+    if n >= n_pad:
+        return idx[:n_pad]
+    pad_val = idx[-1] if n else jnp.int32(0)
+    return jnp.concatenate(
+        [idx, jnp.full((n_pad - n,), pad_val, jnp.int32)])

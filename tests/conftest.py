@@ -1,10 +1,18 @@
 """Test harness: force an 8-device virtual CPU mesh so distributed learners
 are exercised without real multi-chip hardware (SURVEY.md §4: the TPU analogue
-of the reference's localhost-socket multi-rank trick)."""
+of the reference's localhost-socket multi-rank trick).
+
+The parent environment pins JAX_PLATFORMS=axon (the TPU tunnel), so the env
+var alone is not enough — jax.config must be updated before any backend use.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
