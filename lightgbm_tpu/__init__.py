@@ -6,11 +6,24 @@ histograms are built by MXU one-hot contractions / Pallas kernels, split
 finding is a vectorized scan over bins, and the distributed tree learners run
 XLA collectives over a `jax.sharding.Mesh`.
 """
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
 from .config import Config
-from .io.dataset import Dataset as _RawDataset
+from .engine import cv, train
 
-__version__ = "0.1.0"
+__version__ = "2.2.4"  # capability parity target (reference VERSION.txt)
 
 __all__ = [
-    "Config",
+    "Dataset", "Booster", "Config", "LightGBMError",
+    "train", "cv",
+    "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
 ]
+
+try:  # sklearn API is optional (mirrors the reference's compat gating)
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,453 @@
+"""Public Dataset / Booster API.
+
+Re-creates the reference python package surface
+(`python-package/lightgbm/basic.py`): lazily-constructed `Dataset` with
+reference alignment for validation sets, field set/get, and a `Booster` with
+`update/eval/predict/save_model/model_to_string/feature_importance` — except
+the ctypes/C-API indirection is gone: the booster drives the JAX GBDT core
+directly (the reference's one-C-call-per-iteration boundary becomes one
+host->device step per iteration).
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import Dataset as _CoreDataset
+from .models.gbdt import GBDT
+from .models.model_text import (dump_model_json, load_model_from_string,
+                                save_model_to_string, _feature_infos)
+from .models.tree import Tree
+from .ops.metrics import create_metrics, metric_names
+from .ops.objectives import create_objective
+from .ops.predict import predict_raw_values
+
+
+class LightGBMError(Exception):
+    pass
+
+
+def _to_matrix(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return data.astype(np.float64, copy=False)
+    if isinstance(data, (list, tuple)):
+        return np.asarray(data, np.float64)
+    if hasattr(data, "values"):  # pandas
+        return np.asarray(data.values, np.float64)
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.asarray(data.toarray(), np.float64)
+    raise LightGBMError(f"Cannot convert data of type {type(data)}")
+
+
+class Dataset:
+    """Lazily-constructed dataset (reference basic.py:600+)."""
+
+    def __init__(self, data, label=None, reference: "Dataset" = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[int]] = "auto",
+                 params: Optional[Dict] = None, free_raw_data: bool = True,
+                 silent: bool = False) -> None:
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[_CoreDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        if self.reference is not None:
+            ref = self.reference.construct()._handle
+        else:
+            ref = None
+        if self.used_indices is not None:
+            # subset of the (constructed) reference (basic.py subset path)
+            parent = self.reference.construct()._handle
+            self._handle = parent.subset(self.used_indices)
+            if self.label is not None:
+                self._handle.metadata.set_label(self.label)
+            if self.group is not None:
+                self._handle.metadata.set_group(self.group)
+            return self
+        cfg = Config.from_params(self.params)
+        mat = _to_matrix(self.data)
+        feature_names = (None if self.feature_name in ("auto", None)
+                         else list(self.feature_name))
+        cats = (None if self.categorical_feature in ("auto", None)
+                else [int(c) for c in self.categorical_feature])
+        self._handle = _CoreDataset.from_matrix(
+            mat, label=self.label, config=cfg, weight=self.weight,
+            group=self.group, init_score=self.init_score,
+            feature_names=feature_names, categorical_feature=cats,
+            reference=ref)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        ds = Dataset(None, reference=self,
+                     params=params or self.params)
+        ds.used_indices = np.asarray(used_indices, np.int64)
+        return ds
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._handle is not None and label is not None:
+            self._handle.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._handle is not None:
+            self._handle.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._handle is not None:
+            self._handle.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._handle is not None:
+            self._handle.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        if self._handle is not None and self._handle.metadata.label is not None:
+            return np.asarray(self._handle.metadata.label)
+        return self.label
+
+    def get_weight(self):
+        if self._handle is not None and self._handle.metadata.weight is not None:
+            return np.asarray(self._handle.metadata.weight)
+        return self.weight
+
+    def get_group(self):
+        if self._handle is not None and \
+                self._handle.metadata.query_boundaries is not None:
+            return np.diff(self._handle.metadata.query_boundaries)
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_field(self, name):
+        return {"label": self.get_label, "weight": self.get_weight,
+                "group": self.get_group,
+                "init_score": self.get_init_score}[name]()
+
+    def set_field(self, name, data):
+        return {"label": self.set_label, "weight": self.set_weight,
+                "group": self.set_group,
+                "init_score": self.set_init_score}[name](data)
+
+    @property
+    def num_data(self) -> int:
+        self.construct()
+        return self._handle.num_data
+
+    @property
+    def num_feature(self) -> int:
+        self.construct()
+        return self._handle.num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._handle.save_binary(filename)
+        return self
+
+    def _update_params(self, params) -> "Dataset":
+        self.params.update(params or {})
+        return self
+
+
+class Booster:
+    """reference basic.py:1578 Booster."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 silent: bool = False) -> None:
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_set = train_set
+        self._gbdt: Optional[GBDT] = None
+        self._loaded: Optional[Dict] = None
+        self._name_valid_sets: List[str] = []
+        self._valid_sets_public: List["Dataset"] = []
+        self.name_train_set = "training"
+        if model_file is not None:
+            with open(model_file) as fh:
+                self._init_from_string(fh.read())
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        elif train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance")
+            train_set.construct()
+            cfg = Config.from_params(self.params)
+            self._cfg = cfg
+            self._gbdt = GBDT(cfg, train_set._handle)
+        else:
+            raise LightGBMError(
+                "need at least one of train_set/model_file/model_str")
+
+    # ------------------------------------------------------------------
+    def _init_from_string(self, text: str) -> None:
+        self._loaded = load_model_from_string(text)
+        self.params = dict(self._loaded.get("params", {}))
+        self._cfg = Config.from_params(
+            {"objective": self._loaded["objective"].split(" ")[0],
+             "num_class": self._loaded["num_class"]})
+
+    @property
+    def trees(self) -> List[Tree]:
+        if self._gbdt is not None:
+            return self._gbdt.models
+        return self._loaded["trees"] if self._loaded else []
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.num_tree_per_iteration
+        return self._loaded.get("num_tree_per_iteration", 1)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self._gbdt.add_valid_dataset(data._handle)
+        self._name_valid_sets.append(name)
+        self._valid_sets_public.append(data)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj=None) -> bool:
+        """One boosting iteration (reference basic.py:1846). Returns True if
+        training finished (cannot split any more)."""
+        if fobj is not None:
+            scores = self._gbdt.train_score.numpy()
+            k = self.num_tree_per_iteration
+            if k == 1:
+                grad, hess = fobj(scores[0], self._train_set)
+            else:
+                grad, hess = fobj(scores.T, self._train_set)
+            grad = np.asarray(grad, np.float32).reshape(k, -1)
+            hess = np.asarray(hess, np.float32).reshape(k, -1)
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.iter if self._gbdt else \
+            len(self.trees) // max(1, self.num_tree_per_iteration)
+
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    # ------------------------------------------------------------------
+    def eval_train(self):
+        return [(n, m, v, b) for n, m, v, b in self._gbdt.eval_train()]
+
+    def eval_valid(self):
+        out = []
+        for i, res in enumerate(self._eval_valid_grouped()):
+            name = self._name_valid_sets[i] if i < len(
+                self._name_valid_sets) else f"valid_{i}"
+            out.extend((name, m, v, b) for _, m, v, b in res)
+        return out
+
+    def _eval_valid_grouped(self):
+        per_set: Dict[str, List] = {}
+        res = self._gbdt.eval_valid()
+        groups: Dict[str, List] = {}
+        for item in res:
+            groups.setdefault(item[0], []).append(item)
+        return [groups[k] for k in sorted(groups)]
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        X = _to_matrix(data)
+        k = self.num_tree_per_iteration
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        trees = self.trees
+        if num_iteration and num_iteration > 0:
+            trees = trees[:num_iteration * k]
+        if pred_leaf:
+            return predict_raw_values(trees, X, leaf_index=True)
+        if pred_contrib:
+            from .ops.shap import predict_contrib
+            return predict_contrib(trees, X, k)
+        n = len(X)
+        raw = np.zeros((n, k), np.float64)
+        for cls in range(k):
+            cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
+            raw[:, cls] = predict_raw_values(cls_trees, X)
+        if self._is_average_output():
+            raw = raw / max(1, len(trees) // k)
+        objective = self._objective_for_predict()
+        if not raw_score and objective is not None:
+            if k > 1 and objective.name == "multiclass":
+                conv = objective.convert_output(raw)
+            else:
+                conv = np.stack([objective.convert_output(raw[:, c])
+                                 for c in range(k)], axis=1)
+        else:
+            conv = raw
+        return conv[:, 0] if k == 1 else conv
+
+    def _is_average_output(self) -> bool:
+        if self._loaded is not None:
+            return bool(self._loaded.get("average_output"))
+        return self._cfg.boosting == "rf"
+
+    def _objective_for_predict(self):
+        try:
+            if self._gbdt is not None:
+                return self._gbdt.objective
+            return create_objective(self._cfg)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        if self._gbdt is not None:
+            ds = self._gbdt.train_data
+            obj = self._gbdt.objective
+            obj_str = self._objective_string(obj)
+            return save_model_to_string(
+                self._gbdt.models, self._cfg, self.num_tree_per_iteration,
+                ds.num_total_features - 1, ds.feature_names,
+                _feature_infos(ds.mappers), num_iteration, obj_str)
+        # loaded model: re-serialize
+        fn = self._loaded.get("feature_names") or []
+        return save_model_to_string(
+            self._loaded["trees"], self._cfg,
+            self._loaded["num_tree_per_iteration"],
+            self._loaded.get("max_feature_idx", max(len(fn) - 1, 0)),
+            fn, self._loaded.get("feature_infos"), num_iteration,
+            self._loaded.get("objective", ""))
+
+    @staticmethod
+    def _objective_string(obj) -> str:
+        if obj is None:
+            return ""
+        extras = {
+            "binary": lambda o: f" sigmoid:{o.cfg.sigmoid}",
+            "multiclass": lambda o: f" num_class:{o.num_class}",
+            "multiclassova": lambda o:
+                f" num_class:{o.num_class} sigmoid:{o.cfg.sigmoid}",
+            "lambdarank": lambda o: "",
+        }
+        return obj.name + extras.get(obj.name, lambda o: "")(obj)
+
+    def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration))
+        return self
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        if self._gbdt is not None:
+            ds = self._gbdt.train_data
+            return dump_model_json(
+                self._gbdt.models, self._cfg, self.num_tree_per_iteration,
+                ds.num_total_features - 1, ds.feature_names, num_iteration,
+                self._objective_string(self._gbdt.objective))
+        fn = self._loaded.get("feature_names") or []
+        return dump_model_json(
+            self._loaded["trees"], self._cfg,
+            self._loaded["num_tree_per_iteration"],
+            self._loaded.get("max_feature_idx", max(len(fn) - 1, 0)),
+            fn, num_iteration, self._loaded.get("objective", ""))
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        """reference Booster.feature_importance (basic.py:2410+)."""
+        if self._gbdt is not None:
+            nf = self._gbdt.train_data.num_total_features
+        else:
+            nf = self._loaded.get("max_feature_idx", 0) + 1
+        imp = np.zeros(nf)
+        trees = self.trees
+        if iteration and iteration > 0:
+            trees = trees[:iteration * self.num_tree_per_iteration]
+        for t in trees:
+            for node in range(t.num_leaves - 1):
+                f = t.split_feature[node]
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += t.split_gain[node]
+        return imp
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt is not None:
+            return list(self._gbdt.train_data.feature_names)
+        return list(self._loaded.get("feature_names") or [])
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def set_network(self, machines, local_listen_port=12400,
+                    listen_time_out=120, num_machines=1) -> "Booster":
+        # TPU build: collectives ride the jax.sharding mesh, not sockets
+        # (reference basic.py:1737; network seam = parallel/ learners)
+        return self
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        return Booster(model_str=self.model_to_string())
+
+    def __getstate__(self):
+        return {"model_str": self.model_to_string(),
+                "best_iteration": self.best_iteration,
+                "best_score": self.best_score,
+                "params": self.params}
+
+    def __setstate__(self, state):
+        self.__init__(model_str=state["model_str"])
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self.params = state.get("params", {})

@@ -1,0 +1,307 @@
+"""Single-device leaf-wise tree learner.
+
+Re-creates the reference `SerialTreeLearner` (`src/treelearner/
+serial_tree_learner.cpp:173-892`): best-first growth to `num_leaves`, where
+each step histograms the SMALLER child and derives the larger by parent-minus-
+smaller subtraction (`BeforeFindBestSplit` smaller/larger assignment
+`:364-441`, `FindBestSplits` `:443-595`), applies the split to the row
+partition, and propagates monotone mid-constraints (`Split` `:757-851`).
+
+TPU mapping:
+- binned matrix + partition indices + grad/hess live in HBM
+- histogram = MXU one-hot contraction over the leaf's gathered rows
+  (`ops/histogram.py`), jit-cached per power-of-two padded leaf size
+- split finding = one vectorized program over all features (`ops/split.py`)
+- the only host/device sync per split is the chosen SplitInfo scalars —
+  the analogue of the reference's per-leaf best-split argmax on host
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.binning import BIN_CATEGORICAL
+from ..io.dataset import Dataset
+from ..ops.histogram import leaf_histogram, subtract_histogram
+from ..ops.partition import init_partition, init_partition_from, \
+    split_partition
+from ..ops.split import SplitHyper, make_split_finder
+from .tree import Tree
+
+_MISSING_CODE_TO_C = {"none": 0, "zero": 1, "nan": 2}
+
+
+def _pow2_pad(n: int, min_pad: int) -> int:
+    return max(min_pad, 1 << max(int(math.ceil(math.log2(max(n, 1)))), 0))
+
+
+class _LeafInfo:
+    __slots__ = ("begin", "count", "sum_g", "sum_h", "hist", "best",
+                 "depth", "min_constraint", "max_constraint")
+
+    def __init__(self, begin, count, sum_g, sum_h, depth=0,
+                 min_constraint=-np.inf, max_constraint=np.inf):
+        self.begin = begin
+        self.count = count
+        self.sum_g = sum_g
+        self.sum_h = sum_h
+        self.hist = None
+        self.best = None
+        self.depth = depth
+        self.min_constraint = min_constraint
+        self.max_constraint = max_constraint
+
+
+class SerialTreeLearner:
+    """Reference `TreeLearner` contract (`include/LightGBM/tree_learner.h`)."""
+
+    def __init__(self, cfg: Config, dataset: Dataset) -> None:
+        self.cfg = cfg
+        self.ds = dataset
+        self.n = dataset.num_data
+        self.num_features = dataset.num_features
+        meta = dataset.feature_meta_arrays()
+        self.meta = meta
+        self.max_bin_global = int(meta["num_bin"].max()) \
+            if len(meta["num_bin"]) else 2
+        self.bins_dev = jnp.asarray(dataset.bins)
+        self.hyper = SplitHyper.from_config(cfg)
+        self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
+        self.mappers = dataset.used_mappers()
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        # partition storage: leaf slices stay contiguous; extra tail so a
+        # padded dynamic_slice never wraps (see ops/partition.py)
+        self.n_pad = self.n + _pow2_pad(self.n, cfg.tpu_min_pad)
+        self.indices = init_partition(self.n, self.n_pad)
+        self.hist_precision = ("f32" if cfg.gpu_use_dp or cfg.tpu_use_f64_hist
+                               else "bf16x2")
+        self._monotone_any = bool(np.any(meta["monotone"] != 0))
+
+    # ------------------------------------------------------------------
+    def _feature_mask(self) -> Optional[np.ndarray]:
+        """Per-tree column sampling (reference BeforeTrain feature sampling,
+        serial_tree_learner.cpp:275-296)."""
+        frac = self.cfg.feature_fraction
+        if frac >= 1.0:
+            return None
+        used_cnt = max(1, int(round(self.num_features * frac)))
+        mask = np.zeros(self.num_features, bool)
+        sel = self._feat_rng.choice(self.num_features, used_cnt,
+                                    replace=False)
+        mask[sel] = True
+        return mask
+
+    def _leaf_hist(self, leaf: _LeafInfo, grad, hess):
+        padded = _pow2_pad(leaf.count, self.cfg.tpu_min_pad)
+        return leaf_histogram(
+            self.bins_dev, self.indices, jnp.int32(leaf.begin),
+            jnp.int32(leaf.count), grad, hess, padded=padded,
+            max_bin=self.max_bin_global, chunk=self.cfg.tpu_hist_chunk,
+            precision=self.hist_precision)
+
+    def _find_best(self, leaf: _LeafInfo, feature_mask) -> dict:
+        out = self.finder(leaf.hist, jnp.float32(leaf.sum_g),
+                          jnp.float32(leaf.sum_h), jnp.int32(leaf.count),
+                          jnp.float32(leaf.min_constraint),
+                          jnp.float32(leaf.max_constraint))
+        gain = np.asarray(out["gain"], np.float64)
+        if feature_mask is not None:
+            gain = np.where(feature_mask, gain, -np.inf)
+        # depth limit (BeforeFindBestSplit, serial_tree_learner.cpp:364-377)
+        if 0 < self.cfg.max_depth <= leaf.depth:
+            gain = np.full_like(gain, -np.inf)
+        best_f = int(np.argmax(gain))
+        res = {
+            "feature": best_f,
+            "gain": float(gain[best_f]),
+            "threshold": int(np.asarray(out["threshold"])[best_f]),
+            "default_left": bool(np.asarray(out["default_left"])[best_f]),
+            "left_g": float(np.asarray(out["left_g"])[best_f]),
+            "left_h": float(np.asarray(out["left_h"])[best_f]),
+            "left_c": int(np.asarray(out["left_c"])[best_f]),
+            "right_g": float(np.asarray(out["right_g"])[best_f]),
+            "right_h": float(np.asarray(out["right_h"])[best_f]),
+            "right_c": int(np.asarray(out["right_c"])[best_f]),
+            "left_output": float(np.asarray(out["left_output"])[best_f]),
+            "right_output": float(np.asarray(out["right_output"])[best_f]),
+        }
+        if "use_onehot" in out and \
+                self.meta["bin_type"][best_f] == 1:
+            res["is_cat"] = True
+            if bool(np.asarray(out["use_onehot"])[best_f]):
+                res["cat_bins"] = [res["threshold"]]
+            else:
+                order = np.asarray(out["sort_order"])[best_f]
+                n_elig = int(np.asarray(out["n_elig"])[best_f])
+                cdir = int(np.asarray(out["cat_dir"])[best_f])
+                k = res["threshold"] + 1
+                if cdir == 1:
+                    res["cat_bins"] = [int(order[i]) for i in range(k)]
+                else:
+                    res["cat_bins"] = [int(order[n_elig - 1 - i])
+                                       for i in range(k)]
+        else:
+            res["is_cat"] = False
+        return res
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_indices: Optional[np.ndarray] = None,
+              bag_count: Optional[int] = None) -> Tuple[Tree, Dict]:
+        """Grow one tree (reference SerialTreeLearner::Train,
+        serial_tree_learner.cpp:173-237). grad/hess are full-length [N]
+        device arrays; bag_indices restricts rows (bagging/GOSS)."""
+        cfg = self.cfg
+        feature_mask = self._feature_mask()
+        if bag_indices is not None:
+            count = int(bag_count if bag_count is not None
+                        else len(bag_indices))
+            self.indices = init_partition_from(bag_indices, self.n_pad)
+        else:
+            count = self.n
+            self.indices = init_partition(self.n, self.n_pad)
+
+        # root sums (BeforeTrain root sumup, serial_tree_learner.cpp:307-316)
+        padded_root = _pow2_pad(count, cfg.tpu_min_pad)
+        root = _LeafInfo(0, count, 0.0, 0.0)
+        root.hist = self._leaf_hist(root, grad, hess)
+        # root grad/hess totals from the histogram of feature 0 would drop
+        # rows beyond num_bin masking; use a direct masked reduction instead
+        sums = _root_sums(self.indices, grad, hess, jnp.int32(count),
+                          padded_root)
+        root.sum_g = float(np.asarray(sums[0]))
+        root.sum_h = float(np.asarray(sums[1]))
+        root.best = self._find_best(root, feature_mask)
+
+        tree = Tree(cfg.num_leaves)
+        leaves: Dict[int, _LeafInfo] = {0: root}
+        leaf_begin_count: Dict[int, Tuple[int, int]] = {}
+
+        for _ in range(cfg.num_leaves - 1):
+            # pick max-gain leaf (Train loop, serial_tree_learner.cpp:201-224)
+            best_leaf, best_gain = -1, 0.0
+            for lid, info in leaves.items():
+                if info.best is not None and info.best["gain"] > best_gain \
+                        and np.isfinite(info.best["gain"]):
+                    best_leaf, best_gain = lid, info.best["gain"]
+            if best_leaf < 0:
+                break
+            info = leaves[best_leaf]
+            b = info.best
+            f = b["feature"]
+            mapper = self.mappers[f]
+            mt_c = _MISSING_CODE_TO_C[mapper.missing_type]
+
+            # --- tree update
+            real_feature = int(self.ds.real_feature_idx[f])
+            if b["is_cat"]:
+                cat_bins = b["cat_bins"]
+                cats = [mapper.bin_2_categorical[bb] for bb in cat_bins
+                        if bb < len(mapper.bin_2_categorical)]
+                right_leaf = tree.split_categorical(
+                    best_leaf, f, real_feature, cat_bins, cats,
+                    b["left_output"], b["right_output"], b["left_c"],
+                    b["right_c"], b["gain"], mt_c,
+                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+                cat_bitset = np.zeros(8, np.uint32)
+                for bb in cat_bins:
+                    cat_bitset[bb // 32] |= np.uint32(1) << np.uint32(bb % 32)
+            else:
+                thr_double = mapper.bin_to_value(b["threshold"])
+                right_leaf = tree.split(
+                    best_leaf, f, real_feature, b["threshold"], thr_double,
+                    b["left_output"], b["right_output"], b["left_c"],
+                    b["right_c"], b["gain"], mt_c, b["default_left"],
+                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+                cat_bitset = np.zeros(8, np.uint32)
+
+            # --- partition update
+            padded = _pow2_pad(info.count, cfg.tpu_min_pad)
+            self.indices, lcnt_dev = split_partition(
+                self.indices, self.bins_dev[:, f], jnp.int32(info.begin),
+                jnp.int32(info.count), padded, jnp.int32(b["threshold"]),
+                jnp.asarray(b["default_left"]), jnp.int32(mt_c),
+                jnp.int32(mapper.default_bin), jnp.int32(mapper.num_bin),
+                jnp.asarray(b["is_cat"]), jnp.asarray(cat_bitset))
+            left_count = int(np.asarray(lcnt_dev))
+            # partition and split-finder counts can differ only by numeric
+            # noise in f32 histogram counts; trust the partition
+            right_count = info.count - left_count
+
+            # --- child leaf infos + monotone constraint propagation
+            # (serial_tree_learner.cpp:826-851)
+            lmin, lmax = info.min_constraint, info.max_constraint
+            rmin, rmax = info.min_constraint, info.max_constraint
+            mono = int(self.meta["monotone"][f]) if self._monotone_any else 0
+            if mono != 0:
+                mid = (b["left_output"] + b["right_output"]) / 2.0
+                if mono > 0:
+                    lmax = min(lmax, mid)
+                    rmin = max(rmin, mid)
+                else:
+                    lmin = max(lmin, mid)
+                    rmax = min(rmax, mid)
+            left = _LeafInfo(info.begin, left_count, b["left_g"],
+                             b["left_h"], info.depth + 1, lmin, lmax)
+            right = _LeafInfo(info.begin + left_count, right_count,
+                              b["right_g"], b["right_h"], info.depth + 1,
+                              rmin, rmax)
+
+            # --- histogram: construct smaller, subtract for larger
+            if left_count <= right_count:
+                smaller, larger = left, right
+            else:
+                smaller, larger = right, left
+            can_split_more = (tree.num_leaves < cfg.num_leaves)
+            if can_split_more:
+                smaller.hist = self._leaf_hist(smaller, grad, hess)
+                larger.hist = subtract_histogram(info.hist, smaller.hist)
+                smaller.best = self._find_best(smaller, feature_mask)
+                larger.best = self._find_best(larger, feature_mask)
+            leaves[best_leaf] = left
+            leaves[right_leaf] = right
+            info.hist = None  # free parent histogram
+
+        leaf_begin_count = {lid: (inf.begin, inf.count)
+                            for lid, inf in leaves.items()}
+        return tree, leaf_begin_count
+
+    # ------------------------------------------------------------------
+    def renew_tree_output(self, tree: Tree, leaf_begin_count: Dict,
+                          objective, scores_np: np.ndarray,
+                          label_np: np.ndarray,
+                          weights_np: Optional[np.ndarray]) -> None:
+        """Percentile leaf renewal for L1-family objectives (reference
+        SerialTreeLearner::RenewTreeOutput, serial_tree_learner.cpp:854-892).
+        """
+        if not getattr(objective, "is_renew_tree_output", False):
+            return
+        idx_np = np.asarray(self.indices)
+        for lid, (begin, count) in leaf_begin_count.items():
+            rows = idx_np[begin:begin + count]
+            resid = objective.residual(label_np[rows], scores_np[rows])
+            if objective.name == "mape":
+                w = objective._label_weight_np[rows]
+            else:
+                w = weights_np[rows] if weights_np is not None else None
+            # reference order: renew BEFORE shrinkage (gbdt.cpp:400-408)
+            tree.leaf_value[lid] = objective.renew_leaf_output(resid, w)
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("padded",))
+def _root_sums(indices, grad, hess, count, padded: int):
+    idx = jax.lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
+    pos = jnp.arange(padded, dtype=jnp.int32)
+    valid = pos < count
+    safe = jnp.where(valid, idx, 0)
+    g = jnp.where(valid, grad[safe], 0.0)
+    h = jnp.where(valid, hess[safe], 0.0)
+    return jnp.stack([g.sum(), h.sum()])

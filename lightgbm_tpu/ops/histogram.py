@@ -16,7 +16,6 @@ exact; payload precision is recovered with a hi/lo split (two bf16 matmuls
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

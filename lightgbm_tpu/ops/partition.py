@@ -84,7 +84,9 @@ def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("n", "n_pad"))
 def init_partition(n: int, n_pad: int) -> jax.Array:
-    """Root partition: identity permutation padded with sentinel n."""
+    """Root partition: identity permutation; the tail repeats row n-1 (tail
+    entries are never addressed — leaf (begin, count) bookkeeping keeps all
+    real slices inside [0, n))."""
     idx = jnp.arange(n_pad, dtype=jnp.int32)
     return jnp.where(idx < n, idx, n - 1)
 

@@ -1,0 +1,72 @@
+"""Ranking utilities shared by the lambdarank objective and NDCG/MAP metrics.
+
+Re-creates the reference `DCGCalculator` (`src/metric/dcg_calculator.cpp`):
+discount 1/log2(2+i), label gains 2^label-1 (configurable), max-DCG from
+label counts. Adds the TPU-side query bucketing: queries padded to
+power-of-two document counts so per-query pairwise work is batched into a few
+fixed-shape device programs instead of a ragged host loop.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def dcg_discounts(n: int) -> np.ndarray:
+    """discount[i] = 1/log2(2+i) (reference dcg_calculator.cpp:Init)."""
+    return 1.0 / np.log2(2.0 + np.arange(n, dtype=np.float64))
+
+
+def max_dcg_at_k(k: int, labels: np.ndarray, label_gain: np.ndarray) -> float:
+    """reference DCGCalculator::CalMaxDCGAtK (dcg_calculator.cpp:53-77):
+    accumulate discounts over labels sorted descending."""
+    n = len(labels)
+    k = min(k, n)
+    if k <= 0:
+        return 0.0
+    sorted_gains = np.sort(label_gain[labels])[::-1]
+    disc = dcg_discounts(k)
+    return float(np.sum(sorted_gains[:k] * disc))
+
+
+def dcg_at_k(k: int, labels: np.ndarray, scores: np.ndarray,
+             label_gain: np.ndarray) -> float:
+    """reference DCGCalculator::CalDCGAtK: DCG of score-sorted order."""
+    n = len(labels)
+    k = min(k, n)
+    if k <= 0:
+        return 0.0
+    order = np.argsort(-scores, kind="stable")
+    disc = dcg_discounts(k)
+    return float(np.sum(label_gain[labels[order[:k]]] * disc))
+
+
+def bucket_queries(query_boundaries: np.ndarray, min_size: int = 8
+                   ) -> Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Group queries by padded (power-of-two) document count.
+
+    Returns {padded_size: (query_ids [Q], doc_idx [Q, S] int32,
+    mask [Q, S] bool)} where doc_idx are global row ids (pads point at the
+    query's first doc and are masked out).
+    """
+    qb = np.asarray(query_boundaries, np.int64)
+    counts = np.diff(qb)
+    sizes = {}
+    for q, c in enumerate(counts):
+        s = max(min_size, 1 << int(math.ceil(math.log2(max(int(c), 1)))))
+        sizes.setdefault(s, []).append(q)
+    out = {}
+    for s, qids in sizes.items():
+        qids = np.asarray(qids, np.int64)
+        doc_idx = np.zeros((len(qids), s), np.int32)
+        mask = np.zeros((len(qids), s), bool)
+        for row, q in enumerate(qids):
+            lo, hi = int(qb[q]), int(qb[q + 1])
+            c = hi - lo
+            doc_idx[row, :c] = np.arange(lo, hi, dtype=np.int32)
+            doc_idx[row, c:] = lo
+            mask[row, :c] = True
+        out[s] = (qids, doc_idx, mask)
+    return out

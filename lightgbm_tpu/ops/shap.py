@@ -1,0 +1,174 @@
+"""SHAP feature contributions for tree ensembles.
+
+Re-creates the reference `PredictContrib` path (`tree.h:123`,
+`tree.cpp TreeSHAP` — the Lundberg & Lee exact TreeSHAP recursion the
+reference vendored): per-row, per-tree recursive path-weight computation,
+plus the expected-value base term in the last output column.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..models.tree import Tree
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, f=-1, z=0.0, o=0.0, w=0.0):
+        self.feature_index = f
+        self.zero_fraction = z
+        self.one_fraction = o
+        self.pweight = w
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) \
+            / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight \
+            * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int,
+                 path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) \
+                / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            total += path[i].pweight / (zero_fraction
+                                        * (unique_depth - i)
+                                        / (unique_depth + 1))
+    return total
+
+
+def _expected_value(tree: Tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_value[~node])
+    lc = int(tree.left_child[node])
+    rc = int(tree.right_child[node])
+    lcount = _node_count(tree, lc)
+    rcount = _node_count(tree, rc)
+    total = lcount + rcount
+    if total <= 0:
+        return 0.0
+    return (_expected_value(tree, lc) * lcount
+            + _expected_value(tree, rc) * rcount) / total
+
+
+def _node_count(tree: Tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_count[~node])
+    return float(tree.internal_count[node])
+
+
+def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int,
+               mean_values: dict) -> None:
+    path = [_PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
+                         p.pweight) for p in parent_path[:unique_depth]]
+    path += [_PathElement() for _ in range(unique_depth, tree.num_leaves + 2)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf_value = float(tree.leaf_value[~node])
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction
+                                          - el.zero_fraction) * leaf_value
+        return
+
+    # internal node: which child does x go to?
+    hot = _decide(tree, node, x)
+    cold = (tree.right_child[node] if hot == tree.left_child[node]
+            else tree.left_child[node])
+    hot_count = _node_count(tree, int(hot))
+    cold_count = _node_count(tree, int(cold))
+    total = _node_count(tree, node)
+    hot_zero = hot_count / total if total > 0 else 0.0
+    cold_zero = cold_count / total if total > 0 else 0.0
+    incoming_zero, incoming_one = 1.0, 1.0
+    feature = int(tree.split_feature[node])
+    # undo duplicated feature on the path
+    path_index = next((i for i in range(1, unique_depth + 1)
+                       if path[i].feature_index == feature), -1)
+    if path_index >= 0:
+        incoming_zero = path[path_index].zero_fraction
+        incoming_one = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(tree, x, phi, int(hot), unique_depth + 1, path,
+               hot_zero * incoming_zero, incoming_one, feature, mean_values)
+    _tree_shap(tree, x, phi, int(cold), unique_depth + 1, path,
+               cold_zero * incoming_zero, 0.0, feature, mean_values)
+
+
+def _decide(tree: Tree, node: int, x: np.ndarray) -> int:
+    return tree._decision(float(x[tree.split_feature[node]]), node)
+
+
+def predict_contrib(trees: List[Tree], X: np.ndarray,
+                    num_class: int = 1) -> np.ndarray:
+    """Returns [N, (F+1)] (or [N, K*(F+1)] for multiclass): per-feature SHAP
+    values plus the expected-value column (reference c_api predict contrib
+    layout)."""
+    X = np.asarray(X, np.float64)
+    n, f = X.shape
+    out = np.zeros((n, num_class, f + 1), np.float64)
+    for ti, tree in enumerate(trees):
+        cls = ti % num_class
+        if tree.num_leaves <= 1:
+            out[:, cls, f] += tree.leaf_value[0]
+            continue
+        base = _expected_value(tree, 0)
+        for r in range(n):
+            phi = np.zeros(f + 1)
+            phi[f] += base
+            _tree_shap(tree, X[r], phi, 0, 0, [], 1.0, 1.0, -1, {})
+            out[r, cls] += phi
+    if num_class == 1:
+        return out[:, 0, :]
+    return out.reshape(n, num_class * (f + 1))

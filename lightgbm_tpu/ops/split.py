@@ -27,7 +27,7 @@ Semantics carried over exactly:
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple
+from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp

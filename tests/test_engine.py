@@ -1,0 +1,300 @@
+"""End-to-end engine tests, mirroring the reference test strategy
+(`tests/python_package_test/test_engine.py`): metric-threshold assertions on
+synthetic data per capability."""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=1000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = X[:, 0] * 2 + X[:, 1] - X[:, 2] * 0.5 + rng.randn(n) * 0.5
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=1000, f=10, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 3 + np.sin(X[:, 1]) * 2 + rng.randn(n) * 0.1
+    return X, y
+
+
+def test_binary():
+    X, y = _binary_data()
+    Xt, yt = _binary_data(seed=42)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    dv = lgb.Dataset(Xt, yt, reference=ds)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=50, valid_sets=[dv],
+                    evals_result=evals_result, verbose_eval=False)
+    ll = evals_result["valid_0"]["binary_logloss"][-1]
+    assert ll < 0.25
+    # predictions agree with recorded eval
+    pred = bst.predict(Xt)
+    assert pred.shape == (len(Xt),)
+    assert ((pred > 0.5) == (yt > 0)).mean() > 0.9
+    # raw score vs sigmoid
+    raw = bst.predict(Xt, raw_score=True)
+    np.testing.assert_allclose(1 / (1 + np.exp(-raw)), pred, rtol=1e-6)
+
+
+def test_regression():
+    X, y = _regression_data()
+    Xt, yt = _regression_data(seed=7)
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 31,
+              "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    dv = lgb.Dataset(Xt, yt, reference=ds)
+    evals_result = {}
+    bst = lgb.train(params, ds, 80, valid_sets=[dv],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["valid_0"]["l2"][-1] < 0.5
+    # monotone improvement on train
+    pred = bst.predict(Xt)
+    assert np.mean((pred - yt) ** 2) < 0.5
+
+
+def test_regression_l1_renewal():
+    X, y = _regression_data()
+    params = {"objective": "regression_l1", "metric": "l1",
+              "num_leaves": 31, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    evals_result = {}
+    bst = lgb.train(params, ds, 60, valid_sets=[ds],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["training"]["l1"][-1] < 0.5
+
+
+def test_missing_values_nan():
+    X, y = _binary_data(2000)
+    X[::3, 0] = np.nan
+    params = {"objective": "binary", "metric": "binary_error",
+              "num_leaves": 15, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    evals_result = {}
+    bst = lgb.train(params, ds, 40, valid_sets=[ds],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["training"]["binary_error"][-1] < 0.2
+    # NaN rows predict without error
+    pred = bst.predict(X[:10])
+    assert np.all(np.isfinite(pred))
+
+
+def test_multiclass():
+    rng = np.random.RandomState(3)
+    n = 1500
+    X = rng.randn(n, 8)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    params = {"objective": "multiclass", "num_class": 3,
+              "metric": "multi_logloss", "num_leaves": 15, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    evals_result = {}
+    bst = lgb.train(params, ds, 40, valid_sets=[ds],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["training"]["multi_logloss"][-1] < 0.4
+    pred = bst.predict(X)
+    assert pred.shape == (n, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    assert (pred.argmax(axis=1) == y).mean() > 0.85
+
+
+def test_early_stopping():
+    X, y = _binary_data(2000)
+    Xt, yt = _binary_data(500, seed=9)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 31, "learning_rate": 0.3, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    dv = lgb.Dataset(Xt, yt, reference=ds)
+    bst = lgb.train(params, ds, 200, valid_sets=[dv],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.best_iteration < 200
+
+
+def test_continued_training():
+    X, y = _regression_data()
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 15,
+              "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    bst1 = lgb.train(params, ds, 20, verbose_eval=False)
+    n1 = bst1.num_trees()
+    ds2 = lgb.Dataset(X, y)
+    bst2 = lgb.train(params, ds2, 20, init_model=bst1, verbose_eval=False)
+    assert bst2.num_trees() == n1 + 20
+    # continued model predicts better than the first
+    p1 = np.mean((bst1.predict(X) - y) ** 2)
+    p2 = np.mean((bst2.predict(X) - y) ** 2)
+    assert p2 < p1
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = _binary_data()
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train(params, ds, 20, verbose_eval=False)
+    pred1 = bst.predict(X)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    pred2 = bst2.predict(X)
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-9)
+    # string round-trip too
+    bst3 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(pred1, bst3.predict(X), rtol=1e-9)
+    # JSON dump is valid and carries trees
+    dump = bst.dump_model()
+    assert dump["num_class"] == 1
+    assert len(dump["tree_info"]) == bst.num_trees()
+
+
+def test_pickle_roundtrip():
+    X, y = _binary_data()
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), 10, verbose_eval=False)
+    blob = pickle.dumps(bst)
+    bst2 = pickle.loads(blob)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-9)
+
+
+def test_pred_leaf():
+    X, y = _binary_data()
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), 5, verbose_eval=False)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (len(X), 5)
+    assert leaves.max() < 7
+
+
+def test_pred_contrib_sums_to_prediction():
+    X, y = _regression_data(300, 5)
+    params = {"objective": "regression", "num_leaves": 7, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), 5, verbose_eval=False)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, 6)
+    raw = bst.predict(X[:20], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_bagging_and_feature_fraction():
+    X, y = _binary_data(2000)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.6, "verbose": -1}
+    evals_result = {}
+    bst = lgb.train(params, lgb.Dataset(X, y), 40,
+                    valid_sets=[lgb.Dataset(X, y)],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["valid_0"]["auc"][-1] > 0.95
+
+
+def test_categorical_features():
+    rng = np.random.RandomState(5)
+    n = 2000
+    cat = rng.randint(0, 8, n)
+    Xnum = rng.randn(n, 3)
+    X = np.column_stack([Xnum, cat.astype(float)])
+    effect = np.array([2.0, -1.0, 0.5, 1.5, -2.0, 0.0, 3.0, -0.5])
+    y = Xnum[:, 0] + effect[cat] + rng.randn(n) * 0.2
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 31,
+              "verbose": -1, "min_data_per_group": 10}
+    ds = lgb.Dataset(X, y, categorical_feature=[3])
+    evals_result = {}
+    bst = lgb.train(params, ds, 60, valid_sets=[ds],
+                    evals_result=evals_result, verbose_eval=False)
+    assert evals_result["training"]["l2"][-1] < 0.3
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.3
+
+
+def test_monotone_constraints():
+    rng = np.random.RandomState(6)
+    n = 2000
+    X = rng.rand(n, 3)
+    y = 3 * X[:, 0] + rng.randn(n) * 0.1
+    params = {"objective": "regression", "num_leaves": 31,
+              "monotone_constraints": "1,0,0", "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), 40, verbose_eval=False)
+    # predictions must be non-decreasing in feature 0
+    grid = np.linspace(0.01, 0.99, 50)
+    for trial in range(5):
+        base = rng.rand(3)
+        rows = np.tile(base, (50, 1))
+        rows[:, 0] = grid
+        pred = bst.predict(rows)
+        assert np.all(np.diff(pred) >= -1e-10)
+
+
+def test_cv():
+    X, y = _binary_data(600)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 7, "verbose": -1}
+    res = lgb.cv(params, lgb.Dataset(X, y), num_boost_round=10, nfold=3,
+                 stratified=True, verbose_eval=False)
+    assert "binary_logloss-mean" in "".join(res.keys()) or any(
+        "binary_logloss" in k for k in res)
+    key = [k for k in res if k.endswith("-mean")][0]
+    assert len(res[key]) == 10
+    assert res[key][-1] < res[key][0]
+
+
+def test_custom_objective_and_metric():
+    X, y = _regression_data()
+
+    def mse_obj(preds, dataset):
+        labels = dataset.get_label()
+        return preds - labels, np.ones_like(preds)
+
+    def mae_metric(preds, dataset):
+        labels = dataset.get_label()
+        return "custom_mae", float(np.mean(np.abs(preds - labels))), False
+
+    params = {"num_leaves": 15, "verbose": -1, "metric": "none"}
+    ds = lgb.Dataset(X, y)
+    evals_result = {}
+    bst = lgb.train(params, ds, 30, valid_sets=[ds], fobj=mse_obj,
+                    feval=mae_metric, evals_result=evals_result,
+                    verbose_eval=False)
+    assert evals_result["training"]["custom_mae"][-1] < 1.0
+
+
+def test_feature_importance():
+    X, y = _regression_data()
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), 20, verbose_eval=False)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (10,)
+    # features 0,1 drive the target
+    assert imp_split[0] > 0 and imp_split[1] > 0
+    assert imp_gain[0] == imp_gain.max()
+
+
+def test_objectives_smoke():
+    """All single-output objectives run and produce finite metrics
+    (reference test_engine.py all-metrics matrix `:936`)."""
+    rng = np.random.RandomState(11)
+    n = 400
+    X = rng.rand(n, 5)
+    y_pos = np.abs(X[:, 0] * 2 + rng.rand(n) * 0.5) + 0.1
+    y_bin = (X[:, 0] > 0.5).astype(float)
+    y_unit = np.clip(X[:, 0], 0.01, 0.99)
+    cases = [
+        ("regression", y_pos), ("regression_l1", y_pos), ("huber", y_pos),
+        ("fair", y_pos), ("poisson", y_pos), ("quantile", y_pos),
+        ("mape", y_pos), ("gamma", y_pos), ("tweedie", y_pos),
+        ("binary", y_bin), ("xentropy", y_unit), ("xentlambda", y_unit),
+    ]
+    for obj, yy in cases:
+        params = {"objective": obj, "num_leaves": 7, "verbose": -1,
+                  "min_data_in_leaf": 10}
+        bst = lgb.train(params, lgb.Dataset(X, yy), 5, verbose_eval=False)
+        pred = bst.predict(X)
+        assert np.all(np.isfinite(pred)), obj
