@@ -18,6 +18,7 @@ import numpy as np
 
 from .config import Config
 from .io.dataset import Dataset as _CoreDataset
+from .models.boosting_variants import create_boosting
 from .models.gbdt import GBDT
 from .models.model_text import (dump_model_json, load_model_from_string,
                                 save_model_to_string, _feature_infos)
@@ -213,7 +214,7 @@ class Booster:
             train_set.construct()
             cfg = Config.from_params(self.params)
             self._cfg = cfg
-            self._gbdt = GBDT(cfg, train_set._handle)
+            self._gbdt = create_boosting(cfg, train_set._handle)
         else:
             raise LightGBMError(
                 "need at least one of train_set/model_file/model_str")

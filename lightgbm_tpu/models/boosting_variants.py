@@ -1,0 +1,263 @@
+"""Boosting variants: GOSS, DART, RF + the boosting factory.
+
+Re-creates `src/boosting/goss.hpp`, `src/boosting/dart.hpp`,
+`src/boosting/rf.hpp` and the name factory `Boosting::CreateBoosting`
+(`src/boosting/boosting.cpp:35-69`).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset
+from .gbdt import GBDT, K_EPSILON, _ScoreUpdater
+from .tree import Tree
+
+
+class GOSS(GBDT):
+    """Gradient-based one-side sampling (goss.hpp:25-160): keep the
+    top_rate fraction by |g*h|, sample other_rate of the rest and up-weight
+    their gradients by (1-top_rate)/other_rate."""
+
+    def __init__(self, cfg: Config, train_data: Dataset, objective=None):
+        super().__init__(cfg, train_data, objective)
+        if not (cfg.top_rate + cfg.other_rate <= 1.0):
+            raise ValueError("top_rate + other_rate must be <= 1.0")
+        if cfg.top_rate <= 0.0 or cfg.other_rate <= 0.0:
+            raise ValueError("top_rate and other_rate must be positive")
+        self._goss_multiplier: Optional[np.ndarray] = None
+
+    def _bagging(self, iter_idx: int) -> None:
+        """goss.hpp:141-160: no subsampling during the first
+        1/learning_rate iterations."""
+        cfg = self.cfg
+        self._goss_multiplier = None
+        if iter_idx < int(1.0 / cfg.learning_rate):
+            self.bag_data_indices = None
+            self.bag_data_cnt = self.num_data
+            return
+        # |g*h| summed over classes (goss.hpp:96-101)
+        g = np.abs(np.asarray(self._cur_grad) * np.asarray(self._cur_hess)
+                   ).sum(axis=0)
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        threshold = np.partition(g, n - top_k)[n - top_k]
+        big = g >= threshold
+        rest_idx = np.nonzero(~big)[0]
+        take = self._bag_rng.choice(len(rest_idx),
+                                    min(other_k, len(rest_idx)),
+                                    replace=False)
+        sampled = rest_idx[take]
+        sel = np.sort(np.concatenate([np.nonzero(big)[0], sampled]))
+        self.bag_data_indices = sel.astype(np.int32)
+        self.bag_data_cnt = len(sel)
+        multiply = (n - top_k) / other_k
+        mult = np.ones(n, np.float32)
+        mult[sampled] = multiply
+        self._goss_multiplier = mult
+
+    def _post_bagging_gradients(self, gdev, hdev):
+        if self._goss_multiplier is None:
+            return gdev, hdev
+        m = jnp.asarray(self._goss_multiplier)[None, :]
+        return gdev * m, hdev * m
+
+
+class DART(GBDT):
+    """Dropouts meet Multiple Additive Regression Trees (dart.hpp:25-209)."""
+
+    def __init__(self, cfg: Config, train_data: Dataset, objective=None):
+        super().__init__(cfg, train_data, objective)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        self._drop_rng = np.random.RandomState(cfg.drop_seed)
+        self._dropped_this_iter = False
+        self.num_init_iteration = 0
+
+    def get_training_score(self) -> jax.Array:
+        if not self._dropped_this_iter:
+            self._dropping_trees()
+            self._dropped_this_iter = True
+        return self.train_score.score
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._dropped_this_iter = False
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.cfg.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    # ------------------------------------------------------------------
+    def _dropping_trees(self) -> None:
+        """dart.hpp:97-146."""
+        cfg = self.cfg
+        self.drop_index = []
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.tree_weight:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                else:
+                    inv_avg = 1.0
+                if cfg.max_drop > 0 and self.sum_weight > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate \
+                            * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+            else:
+                if cfg.max_drop > 0 and self.iter > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter)
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        # subtract dropped trees from the training score (Shrinkage(-1) +
+        # AddScore)
+        for i in self.drop_index:
+            for k in range(self.num_tree_per_iteration):
+                t = self.models[i * self.num_tree_per_iteration + k]
+                if t.num_leaves > 1:
+                    self.apply_tree_to_score(self.train_score,
+                                             self.train_data.bins, t, k, -1.0)
+        if not self.cfg.xgboost_dart_mode:
+            self.shrinkage_rate = self.cfg.learning_rate \
+                / (1.0 + len(self.drop_index))
+        else:
+            if not self.drop_index:
+                self.shrinkage_rate = self.cfg.learning_rate
+            else:
+                self.shrinkage_rate = self.cfg.learning_rate \
+                    / (self.cfg.learning_rate + len(self.drop_index))
+
+    def _normalize(self) -> None:
+        """dart.hpp:148-196: renormalize dropped trees and patch scores."""
+        cfg = self.cfg
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for cid in range(self.num_tree_per_iteration):
+                t = self.models[i * self.num_tree_per_iteration + cid]
+                if t.num_leaves <= 1:
+                    continue
+                if not cfg.xgboost_dart_mode:
+                    t.apply_shrinkage(1.0 / (k + 1.0))
+                    for ds, su in zip(self.valid_sets, self.valid_scores):
+                        self.apply_tree_to_score(su, ds.bins, t, cid, 1.0)
+                    t.apply_shrinkage(-k)
+                    self.apply_tree_to_score(self.train_score,
+                                             self.train_data.bins, t, cid,
+                                             1.0)
+                else:
+                    t.apply_shrinkage(self.shrinkage_rate)
+                    for ds, su in zip(self.valid_sets, self.valid_scores):
+                        self.apply_tree_to_score(su, ds.bins, t, cid, 1.0)
+                    t.apply_shrinkage(-k / cfg.learning_rate)
+                    self.apply_tree_to_score(self.train_score,
+                                             self.train_data.bins, t, cid,
+                                             1.0)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                    self.tree_weight[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] \
+                        * (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i] *= k / (k + cfg.learning_rate)
+
+
+class RF(GBDT):
+    """Random forest mode (rf.hpp:25-194): mandatory bagging, no shrinkage,
+    one-time gradients from constant init scores, running-average output."""
+
+    def __init__(self, cfg: Config, train_data: Dataset, objective=None):
+        super().__init__(cfg, train_data, objective)
+        if not (cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction < 1.0):
+            raise ValueError("RF needs bagging (bagging_freq > 0 and "
+                             "0 < bagging_fraction < 1)")
+        self.shrinkage_rate = 1.0
+        self.average_output = True
+        self.init_scores = [0.0] * self.num_tree_per_iteration
+        self._rf_boosting()
+
+    def _rf_boosting(self) -> None:
+        """rf.hpp:82-101: gradients from constant init scores, once."""
+        for k in range(self.num_tree_per_iteration):
+            init = 0.0
+            if self.cfg.boost_from_average and self.objective is not None:
+                init = self.objective.boost_from_score(k)
+            self.init_scores[k] = init
+        tmp = jnp.asarray(
+            np.tile(np.asarray(self.init_scores, np.float32)[:, None],
+                    (1, self.num_data)))
+        g, h = self.objective.get_gradients(tmp)
+        self._rf_grad, self._rf_hess = g, h
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        """rf.hpp:103-166."""
+        self._bagging(self.iter)
+        gdev, hdev = self._rf_grad, self._rf_hess
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            leaf_map = {}
+            if self._class_need_train[k]:
+                new_tree, leaf_map = self.learner.train(
+                    gdev[k], hdev[k], self.bag_data_indices,
+                    self.bag_data_cnt)
+            if new_tree.num_leaves > 1:
+                if (self.objective is not None
+                        and getattr(self.objective, "is_renew_tree_output",
+                                    False)):
+                    pred = np.full(self.num_data, self.init_scores[k])
+                    self.learner.renew_tree_output(
+                        new_tree, leaf_map, self.objective, pred,
+                        self._label_np, self._weight_np)
+                if abs(self.init_scores[k]) > K_EPSILON:
+                    new_tree.add_bias(self.init_scores[k])
+                # running average of tree outputs (rf.hpp:141-144)
+                self.train_score.multiply_score(self.iter, k)
+                for su in self.valid_scores:
+                    su.multiply_score(self.iter, k)
+                self._update_score(new_tree, k)
+                self.train_score.multiply_score(1.0 / (self.iter + 1), k)
+                for su in self.valid_scores:
+                    su.multiply_score(1.0 / (self.iter + 1), k)
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    output = 0.0
+                    if not self._class_need_train[k] \
+                            and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    new_tree.as_constant_tree(output)
+            self.models.append(new_tree)
+        self.iter += 1
+        return False
+
+
+def create_boosting(cfg: Config, train_data: Dataset,
+                    objective=None) -> GBDT:
+    """reference Boosting::CreateBoosting (boosting.cpp:35-69)."""
+    name = cfg.boosting
+    if name == "gbdt":
+        return GBDT(cfg, train_data, objective)
+    if name == "goss":
+        return GOSS(cfg, train_data, objective)
+    if name == "dart":
+        return DART(cfg, train_data, objective)
+    if name == "rf":
+        return RF(cfg, train_data, objective)
+    raise ValueError(f"Unknown boosting type: {name}")

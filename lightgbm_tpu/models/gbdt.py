@@ -52,6 +52,11 @@ class _ScoreUpdater:
     def add_constant(self, val: float, class_id: int) -> None:
         self.score = self.score.at[class_id].add(jnp.float32(val))
 
+    def multiply_score(self, factor: float, class_id: int) -> None:
+        """reference ScoreUpdater::MultiplyScore (used by RF running
+        average)."""
+        self.score = self.score.at[class_id].multiply(jnp.float32(factor))
+
     def add_tree_by_leaves(self, leaves: jax.Array, leaf_values: np.ndarray,
                            class_id: int) -> None:
         """leaves: [N] leaf index per row; leaf_values: host array."""
@@ -178,8 +183,25 @@ class GBDT:
         return 0.0
 
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
-        g, h = self.objective.get_gradients(self.train_score.score)
+        g, h = self.objective.get_gradients(self.get_training_score())
         return g, h
+
+    def get_training_score(self) -> jax.Array:
+        """Hook: DART drops trees from the returned score (dart.hpp:77-86)."""
+        return self.train_score.score
+
+    def _post_bagging_gradients(self, gdev, hdev):
+        """Hook: GOSS re-weights sampled small-gradient rows
+        (goss.hpp:102-108)."""
+        return gdev, hdev
+
+    def apply_tree_to_score(self, su: "_ScoreUpdater", bins, tree: Tree,
+                            class_id: int, scale: float = 1.0) -> None:
+        """Add scale * tree(x) into a score updater via binned traversal."""
+        pred = TreePredictor([tree])
+        leaves = pred.predict_binned_leaves(bins)[0]
+        su.add_tree_by_leaves(
+            leaves, tree.leaf_value[:tree.num_leaves] * scale, class_id)
 
     # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
@@ -198,7 +220,9 @@ class GBDT:
                 self.num_tree_per_iteration, self.num_data))
             hdev = jnp.asarray(np.asarray(hess, np.float32).reshape(
                 self.num_tree_per_iteration, self.num_data))
+        self._cur_grad, self._cur_hess = gdev, hdev
         self._bagging(self.iter)
+        gdev, hdev = self._post_bagging_gradients(gdev, hdev)
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):

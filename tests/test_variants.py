@@ -1,0 +1,163 @@
+"""GOSS/DART/RF boosting variants + sklearn wrappers + lambdarank
+(reference test_engine.py:832-884 boosting_type matrix, test_sklearn.py)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=1500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = X[:, 0] * 2 + X[:, 1] - X[:, 2] * 0.5 + rng.randn(n) * 0.5
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def _rank_data(seed=4, nq=60, docs=25):
+    rng = np.random.RandomState(seed)
+    n = nq * docs
+    X = rng.rand(n, 6)
+    rel = (X[:, 0] * 3 + X[:, 1] + rng.rand(n) * 0.5)
+    y = np.clip((rel * 1.2).astype(int), 0, 4).astype(np.float64)
+    group = [docs] * nq
+    return X, y, group
+
+
+def test_goss():
+    X, y = _binary_data(3000)
+    params = {"objective": "binary", "boosting": "goss", "metric": "auc",
+              "num_leaves": 15, "learning_rate": 0.1, "verbose": -1}
+    ev = {}
+    bst = lgb.train(params, lgb.Dataset(X, y), 40,
+                    valid_sets=[lgb.Dataset(X, y)], evals_result=ev,
+                    verbose_eval=False)
+    assert ev["valid_0"]["auc"][-1] > 0.95
+    assert bst.num_trees() == 40
+
+
+def test_dart():
+    X, y = _binary_data()
+    params = {"objective": "binary", "boosting": "dart",
+              "metric": "binary_logloss", "num_leaves": 15,
+              "drop_rate": 0.2, "verbose": -1}
+    ev = {}
+    bst = lgb.train(params, lgb.Dataset(X, y), 40,
+                    valid_sets=[lgb.Dataset(X, y)], evals_result=ev,
+                    verbose_eval=False)
+    assert ev["valid_0"]["binary_logloss"][-1] < 0.4
+    # predictions from the final model (renormalized trees) behave
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == (y > 0)).mean() > 0.85
+
+
+def test_rf():
+    X, y = _binary_data(3000)
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.7, "num_leaves": 31,
+              "metric": "binary_error", "verbose": -1}
+    ev = {}
+    bst = lgb.train(params, lgb.Dataset(X, y), 20,
+                    valid_sets=[lgb.Dataset(X, y)], evals_result=ev,
+                    verbose_eval=False)
+    assert ev["valid_0"]["binary_error"][-1] < 0.2
+    pred = bst.predict(X)
+    # averaged probabilities stay in (0, 1)
+    assert pred.min() > 0 and pred.max() < 1
+    assert ((pred > 0.5) == (y > 0)).mean() > 0.8
+
+
+def test_lambdarank():
+    X, y, group = _rank_data()
+    Xt, yt, gt = _rank_data(seed=9)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": "1,3,5", "num_leaves": 15, "min_data_in_leaf": 10,
+              "verbose": -1}
+    ds = lgb.Dataset(X, y, group=group, params=params)
+    dv = lgb.Dataset(Xt, yt, group=gt, reference=ds)
+    ev = {}
+    bst = lgb.train(params, ds, 30, valid_sets=[dv], evals_result=ev,
+                    verbose_eval=False)
+    ndcg5 = ev["valid_0"]["ndcg@5"]
+    assert ndcg5[-1] > 0.75
+    assert ndcg5[-1] > ndcg5[0] - 1e-9
+
+
+def test_sklearn_regressor():
+    rng = np.random.RandomState(1)
+    X = rng.randn(800, 6)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.randn(800) * 0.1
+    m = lgb.LGBMRegressor(n_estimators=40, num_leaves=15, random_state=7)
+    m.fit(X, y)
+    pred = m.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.3
+    assert m.feature_importances_.shape == (6,)
+    assert m.n_features_ == 6
+
+
+def test_sklearn_classifier_binary():
+    X, y = _binary_data()
+    labels = np.where(y > 0, "pos", "neg")
+    m = lgb.LGBMClassifier(n_estimators=30, num_leaves=15)
+    m.fit(X, labels)
+    assert set(m.classes_) == {"neg", "pos"}
+    pred = m.predict(X)
+    assert (pred == labels).mean() > 0.9
+    proba = m.predict_proba(X)
+    assert proba.shape == (len(X), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_sklearn_classifier_multiclass():
+    rng = np.random.RandomState(3)
+    X = rng.randn(900, 6)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    m = lgb.LGBMClassifier(n_estimators=30, num_leaves=15)
+    m.fit(X, y)
+    assert m.n_classes_ == 3
+    pred = m.predict(X)
+    assert (pred == y).mean() > 0.85
+
+
+def test_sklearn_early_stopping_and_eval():
+    X, y = _binary_data(2000)
+    Xt, yt = _binary_data(400, seed=9)
+    m = lgb.LGBMClassifier(n_estimators=200, num_leaves=31,
+                           learning_rate=0.3)
+    m.fit(X, y, eval_set=[(Xt, yt)], eval_metric="binary_logloss",
+          early_stopping_rounds=5, verbose=False)
+    assert 0 < m.best_iteration_ < 200
+    assert "valid_0" in m.evals_result_
+
+
+def test_sklearn_ranker():
+    X, y, group = _rank_data()
+    m = lgb.LGBMRanker(n_estimators=20, num_leaves=15,
+                       min_child_samples=10)
+    m.fit(X, y, group=group)
+    pred = m.predict(X)
+    assert pred.shape == (len(X),)
+    # higher-relevance docs should rank higher on average
+    assert np.corrcoef(pred, y)[0, 1] > 0.5
+
+
+def test_sklearn_get_set_params():
+    m = lgb.LGBMRegressor(num_leaves=20, learning_rate=0.05)
+    p = m.get_params()
+    assert p["num_leaves"] == 20
+    m.set_params(num_leaves=10)
+    assert m.num_leaves == 10
+
+
+def test_sklearn_custom_objective():
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 4)
+    y = X[:, 0] + rng.randn(500) * 0.1
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    m = lgb.LGBMRegressor(objective=l2_obj, n_estimators=30, num_leaves=15)
+    m.fit(X, y)
+    assert np.mean((m.predict(X) - y) ** 2) < 0.5
