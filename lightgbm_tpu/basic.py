@@ -230,7 +230,7 @@ class Booster:
     @property
     def trees(self) -> List[Tree]:
         if self._gbdt is not None:
-            return self._gbdt.models
+            return self._gbdt.materialized_models()
         return self._loaded["trees"] if self._loaded else []
 
     @property
@@ -353,7 +353,8 @@ class Booster:
             obj = self._gbdt.objective
             obj_str = self._objective_string(obj)
             return save_model_to_string(
-                self._gbdt.models, self._cfg, self.num_tree_per_iteration,
+                self._gbdt.materialized_models(), self._cfg,
+                self.num_tree_per_iteration,
                 ds.num_total_features - 1, ds.feature_names,
                 _feature_infos(ds.mappers), num_iteration, obj_str)
         # loaded model: re-serialize
@@ -387,7 +388,8 @@ class Booster:
         if self._gbdt is not None:
             ds = self._gbdt.train_data
             return dump_model_json(
-                self._gbdt.models, self._cfg, self.num_tree_per_iteration,
+                self._gbdt.materialized_models(), self._cfg,
+                self.num_tree_per_iteration,
                 ds.num_total_features - 1, ds.feature_names, num_iteration,
                 self._objective_string(self._gbdt.objective))
         fn = self._loaded.get("feature_names") or []

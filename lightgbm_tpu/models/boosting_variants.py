@@ -71,6 +71,8 @@ class GOSS(GBDT):
 class DART(GBDT):
     """Dropouts meet Multiple Additive Regression Trees (dart.hpp:25-209)."""
 
+    _fused_ok = False  # drop/renormalize mutates host trees mid-training
+
     def __init__(self, cfg: Config, train_data: Dataset, objective=None):
         super().__init__(cfg, train_data, objective)
         self.tree_weight: List[float] = []
@@ -183,6 +185,8 @@ class DART(GBDT):
 class RF(GBDT):
     """Random forest mode (rf.hpp:25-194): mandatory bagging, no shrinkage,
     one-time gradients from constant init scores, running-average output."""
+
+    _fused_ok = False  # custom TrainOneIter drives the host learner directly
 
     def __init__(self, cfg: Config, train_data: Dataset, objective=None):
         super().__init__(cfg, train_data, objective)

@@ -1,0 +1,567 @@
+"""Fused on-device tree builder: ONE jitted program grows a whole tree.
+
+Why: the host-driven `SerialTreeLearner` issues ~15 host<->device syncs per
+split; on a tunneled TPU each sync costs ~100ms, dwarfing compute. This
+learner keeps the entire leaf-wise loop (reference
+`SerialTreeLearner::Train`, serial_tree_learner.cpp:173-237) inside one
+`lax.fori_loop`: per-leaf state, the histogram pool
+(reference HistogramPool, feature_histogram.hpp:654), the partition, and the
+recorded splits all live in device arrays. Dynamic leaf sizes are handled by
+a `lax.switch` over power-of-two size buckets — each branch compiles its own
+statically-shaped gather + MXU histogram / stable partition.
+
+The host pulls nothing during training; a finished tree is a `TreeRecord`
+pytree of device arrays, convertible to a host `Tree` (one batched transfer)
+only when the model is exported, and convertible to traversal arrays
+on-device for score updates.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..ops.histogram import NUM_HIST_STATS, _chunk_histogram
+from ..ops.partition import categorical_goes_left, numerical_goes_left
+from ..ops.split import SplitHyper, make_split_finder
+from .tree import Tree
+
+NEG_INF = -jnp.inf
+
+
+class TreeRecord(NamedTuple):
+    """Per-split records of one grown tree (device pytree)."""
+    num_splits: jax.Array          # i32 scalar: actual splits made
+    leaf: jax.Array                # i32[L-1] leaf id split at step s
+    feature: jax.Array             # i32[L-1] inner feature index
+    threshold_bin: jax.Array       # i32[L-1]
+    default_left: jax.Array        # bool[L-1]
+    is_cat: jax.Array              # bool[L-1]
+    cat_bitset: jax.Array          # u32[L-1, 8] (bins)
+    left_output: jax.Array         # f32[L-1]
+    right_output: jax.Array        # f32[L-1]
+    left_count: jax.Array          # i32[L-1]
+    right_count: jax.Array         # i32[L-1]
+    gain: jax.Array                # f32[L-1]
+    internal_value: jax.Array      # f32[L-1] (parent output before split)
+    leaf_value: jax.Array          # f32[L] final leaf outputs
+    leaf_count_arr: jax.Array      # i32[L]
+    leaf_begin: jax.Array          # i32[L] partition begins
+    leaf_cnt_part: jax.Array       # i32[L] partition counts
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, int(math.ceil(math.log2(max(n, 1)))))
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def record_to_children(leaf_rec: jax.Array, num_splits: jax.Array,
+                       max_nodes: int) -> Tuple[jax.Array, jax.Array]:
+    """Reconstruct left/right child links from the split sequence.
+
+    Node s split leaf `leaf_rec[s]` into left=same leaf id, right=s+1.
+    left_child[s] -> the NEXT step that splits leaf_rec[s] (as a node), else
+    ~leaf_rec[s]; right_child[s] -> the next step that splits leaf s+1, else
+    ~(s+1).  O(L^2) vectorized — trivial next to histogram work.
+    """
+    s_idx = jnp.arange(max_nodes)
+    later = (s_idx[None, :] > s_idx[:, None]) \
+        & (s_idx[None, :] < num_splits)
+
+    def next_split_of(target):  # target: [max_nodes] leaf ids
+        hit = later & (leaf_rec[None, :] == target[:, None])
+        any_hit = hit.any(axis=1)
+        first = jnp.argmax(hit, axis=1)
+        return any_hit, first
+
+    l_hit, l_first = next_split_of(leaf_rec)
+    left = jnp.where(l_hit, l_first, ~leaf_rec)
+    r_leaf = s_idx + 1
+    r_hit, r_first = next_split_of(r_leaf)
+    right = jnp.where(r_hit, r_first, ~r_leaf)
+    return left.astype(jnp.int32), right.astype(jnp.int32)
+
+
+class DeviceTreeLearner:
+    """Drop-in replacement for SerialTreeLearner with zero mid-tree syncs."""
+
+    def __init__(self, cfg: Config, dataset: Dataset) -> None:
+        self.cfg = cfg
+        self.ds = dataset
+        self.n = dataset.num_data
+        self.num_features = dataset.num_features
+        meta = dataset.feature_meta_arrays()
+        self.meta = meta
+        self.max_bin_global = int(meta["num_bin"].max()) \
+            if len(meta["num_bin"]) else 2
+        self.bins_dev = jnp.asarray(dataset.bins)
+        self.hyper = SplitHyper.from_config(cfg)
+        self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
+        self.mappers = dataset.used_mappers()
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self.hist_precision = ("f32" if cfg.gpu_use_dp or cfg.tpu_use_f64_hist
+                               else "bf16x2")
+        self.min_pad = int(cfg.tpu_min_pad)
+        # device feature metadata for the partition step
+        self._nb_dev = jnp.asarray(meta["num_bin"], jnp.int32)
+        self._db_dev = jnp.asarray(meta["default_bin"], jnp.int32)
+        self._mt_dev = jnp.asarray(meta["missing_type"], jnp.int32)
+        self._mono_any = bool(np.any(meta["monotone"] != 0))
+        self._build_cache: Dict[int, callable] = {}
+        self._depth_limit = cfg.max_depth if cfg.max_depth > 0 else 1 << 30
+
+    # ------------------------------------------------------------------
+    def feature_mask(self) -> Optional[np.ndarray]:
+        frac = self.cfg.feature_fraction
+        if frac >= 1.0:
+            return None
+        used_cnt = max(1, int(round(self.num_features * frac)))
+        mask = np.zeros(self.num_features, bool)
+        mask[self._feat_rng.choice(self.num_features, used_cnt,
+                                   replace=False)] = True
+        return mask
+
+    # ------------------------------------------------------------------
+    def _buckets_for(self, root_count: int) -> List[int]:
+        sizes = []
+        s = self.min_pad
+        top = max(_pow2ceil(root_count), self.min_pad)
+        while s <= top:
+            sizes.append(s)
+            s <<= 1
+        return sizes
+
+    def _bucket_index(self, count, n_buckets: int):
+        """Smallest bucket with min_pad << b >= count — exact integer
+        comparison against the bucket-size table (float log2 would undercount
+        near 2^24 and silently drop rows)."""
+        sizes = jnp.asarray([self.min_pad << b for b in range(n_buckets)],
+                            jnp.int32)
+        b = jnp.sum((count > sizes).astype(jnp.int32))
+        return jnp.clip(b, 0, n_buckets - 1)
+
+    # ------------------------------------------------------------------
+    def _make_build_fn(self, root_padded: int):
+        """Build the jitted whole-tree program for a given root size."""
+        cfg = self.cfg
+        L = cfg.num_leaves
+        F = self.num_features
+        B = self.max_bin_global
+        buckets = self._buckets_for(root_padded)
+        nbk = len(buckets)
+        finder = self.finder
+        nb_dev, db_dev, mt_dev = self._nb_dev, self._db_dev, self._mt_dev
+        chunk = int(cfg.tpu_hist_chunk)
+        precision = self.hist_precision
+        depth_limit = self._depth_limit
+
+        def hist_bucket(size):
+            def fn(bins, indices, grad, hess, begin, count):
+                idx = lax.dynamic_slice(indices, (begin,), (size,))
+                pos = jnp.arange(size, dtype=jnp.int32)
+                valid = pos < count
+                safe = jnp.where(valid, idx, 0)
+                rows = bins[safe].astype(jnp.int32)
+                payload = jnp.stack(
+                    [jnp.where(valid, grad[safe], 0.0),
+                     jnp.where(valid, hess[safe], 0.0),
+                     valid.astype(jnp.float32)], axis=1)
+                if size <= chunk:
+                    return _chunk_histogram(rows, payload, B, precision)
+                n_chunks = size // chunk
+                rows_c = rows.reshape(n_chunks, chunk, F)
+                pay_c = payload.reshape(n_chunks, chunk, NUM_HIST_STATS)
+
+                def body(acc, xs):
+                    r, p = xs
+                    return acc + _chunk_histogram(r, p, B, precision), None
+
+                init = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
+                acc, _ = lax.scan(body, init, (rows_c, pay_c))
+                return acc
+            return fn
+
+        def part_bucket(size):
+            def fn(bins_col, indices, begin, count, threshold, default_left,
+                   missing_type, default_bin, num_bin, is_cat, bitset):
+                idx = lax.dynamic_slice(indices, (begin,), (size,))
+                pos = jnp.arange(size, dtype=jnp.int32)
+                valid = pos < count
+                safe = jnp.where(valid, idx, 0)
+                b = bins_col[safe].astype(jnp.int32)
+                gl_num = numerical_goes_left(b, threshold, default_left,
+                                             missing_type, default_bin,
+                                             num_bin)
+                gl_cat = categorical_goes_left(b, bitset)
+                goes_left = jnp.where(is_cat, gl_cat, gl_num)
+                key = jnp.where(valid, jnp.where(goes_left, 0, 1), 2)
+                order = jnp.argsort(key.astype(jnp.int32), stable=True)
+                new_slice = idx[order]
+                left_count = jnp.sum((key == 0).astype(jnp.int32))
+                new_indices = lax.dynamic_update_slice(indices, new_slice,
+                                                       (begin,))
+                return new_indices, left_count
+            return fn
+
+        hist_fns = [hist_bucket(s) for s in buckets]
+        part_fns = [part_bucket(s) for s in buckets]
+
+        def build(bins, indices, grad, hess, root_count, feature_mask_f32):
+            # ---------- state ----------
+            leaf_begin = jnp.zeros(L, jnp.int32)
+            leaf_count = jnp.zeros(L, jnp.int32).at[0].set(root_count)
+            leaf_depth = jnp.zeros(L, jnp.int32)
+            leaf_minc = jnp.full(L, -jnp.inf, jnp.float32)
+            leaf_maxc = jnp.full(L, jnp.inf, jnp.float32)
+            hist_store = jnp.zeros((L, F, B, NUM_HIST_STATS), jnp.float32)
+
+            best = {
+                "gain": jnp.full(L, NEG_INF, jnp.float32),
+                "feature": jnp.zeros(L, jnp.int32),
+                "threshold": jnp.zeros(L, jnp.int32),
+                "default_left": jnp.zeros(L, bool),
+                "is_cat": jnp.zeros(L, bool),
+                "cat_bitset": jnp.zeros((L, 8), jnp.uint32),
+                "left_g": jnp.zeros(L, jnp.float32),
+                "left_h": jnp.zeros(L, jnp.float32),
+                "left_c": jnp.zeros(L, jnp.int32),
+                "right_g": jnp.zeros(L, jnp.float32),
+                "right_h": jnp.zeros(L, jnp.float32),
+                "right_c": jnp.zeros(L, jnp.int32),
+                "left_output": jnp.zeros(L, jnp.float32),
+                "right_output": jnp.zeros(L, jnp.float32),
+            }
+            rec = {
+                "leaf": jnp.zeros(max(L - 1, 1), jnp.int32),
+                "feature": jnp.zeros(max(L - 1, 1), jnp.int32),
+                "threshold_bin": jnp.zeros(max(L - 1, 1), jnp.int32),
+                "default_left": jnp.zeros(max(L - 1, 1), bool),
+                "is_cat": jnp.zeros(max(L - 1, 1), bool),
+                "cat_bitset": jnp.zeros((max(L - 1, 1), 8), jnp.uint32),
+                "left_output": jnp.zeros(max(L - 1, 1), jnp.float32),
+                "right_output": jnp.zeros(max(L - 1, 1), jnp.float32),
+                "left_count": jnp.zeros(max(L - 1, 1), jnp.int32),
+                "right_count": jnp.zeros(max(L - 1, 1), jnp.int32),
+                "gain": jnp.zeros(max(L - 1, 1), jnp.float32),
+                "internal_value": jnp.zeros(max(L - 1, 1), jnp.float32),
+            }
+            leaf_value = jnp.zeros(L, jnp.float32)
+
+            # ---------- root ----------
+            bsel = self._bucket_index(root_count, nbk)
+            root_hist = lax.switch(
+                bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
+                root_count)
+            hist_store = hist_store.at[0].set(root_hist)
+            # root grad/hess sums by direct reduction
+            root_g, root_h = _masked_sums(indices, grad, hess, root_count,
+                                          root_padded)
+            leaf_sum_g = jnp.zeros(L, jnp.float32).at[0].set(root_g)
+            leaf_sum_h = jnp.zeros(L, jnp.float32).at[0].set(root_h)
+
+            def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                out = finder(hist, sg, sh, cnt, minc, maxc)
+                gain = jnp.where(feature_mask_f32 > 0, out["gain"], NEG_INF)
+                gain = jnp.where(depth >= depth_limit,
+                                 jnp.full_like(gain, NEG_INF), gain)
+                f = jnp.argmax(gain)
+                return {
+                    "gain": gain[f],
+                    "feature": f.astype(jnp.int32),
+                    "threshold": out["threshold"][f],
+                    "default_left": out["default_left"][f],
+                    "is_cat": out["is_cat"][f],
+                    "cat_bitset": out["cat_bitset"][f],
+                    "left_g": out["left_g"][f],
+                    "left_h": out["left_h"][f],
+                    "left_c": out["left_c"][f],
+                    "right_g": out["right_g"][f],
+                    "right_h": out["right_h"][f],
+                    "right_c": out["right_c"][f],
+                    "left_output": out["left_output"][f],
+                    "right_output": out["right_output"][f],
+                }
+
+            root_best = eval_leaf(root_hist, root_g, root_h, root_count,
+                                  jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+                                  jnp.int32(0))
+            best = {k: best[k].at[0].set(root_best[k]) for k in best}
+
+            state = (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+                     leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
+                     leaf_value, jnp.int32(0), jnp.asarray(False))
+
+            def body(s, state):
+                (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+                 leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
+                 leaf_value, n_splits, done) = state
+                bl = jnp.argmax(best["gain"]).astype(jnp.int32)
+                gain_ok = best["gain"][bl] > 0.0
+                do_split = gain_ok & ~done
+
+                def no_op(_):
+                    return (indices, leaf_begin, leaf_count, leaf_sum_g,
+                            leaf_sum_h, leaf_depth, leaf_minc, leaf_maxc,
+                            hist_store, best, rec, leaf_value, n_splits,
+                            jnp.asarray(True))
+
+                def apply(_):
+                    new_leaf = s + 1
+                    f = best["feature"][bl]
+                    thr = best["threshold"][bl]
+                    dleft = best["default_left"][bl]
+                    iscat = best["is_cat"][bl]
+                    bitset = best["cat_bitset"][bl]
+                    begin = leaf_begin[bl]
+                    count = leaf_count[bl]
+                    bk = self._bucket_index(count, nbk)
+                    new_indices, left_cnt = lax.switch(
+                        bk, part_fns, bins[:, f], indices, begin, count, thr,
+                        dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bitset)
+                    right_cnt = count - left_cnt
+
+                    # record
+                    rec2 = dict(rec)
+                    rec2["leaf"] = rec["leaf"].at[s].set(bl)
+                    rec2["feature"] = rec["feature"].at[s].set(f)
+                    rec2["threshold_bin"] = rec["threshold_bin"].at[s].set(thr)
+                    rec2["default_left"] = rec["default_left"].at[s].set(dleft)
+                    rec2["is_cat"] = rec["is_cat"].at[s].set(iscat)
+                    rec2["cat_bitset"] = rec["cat_bitset"].at[s].set(bitset)
+                    rec2["left_output"] = rec["left_output"].at[s].set(
+                        best["left_output"][bl])
+                    rec2["right_output"] = rec["right_output"].at[s].set(
+                        best["right_output"][bl])
+                    rec2["left_count"] = rec["left_count"].at[s].set(left_cnt)
+                    rec2["right_count"] = rec["right_count"].at[s].set(
+                        right_cnt)
+                    rec2["gain"] = rec["gain"].at[s].set(best["gain"][bl])
+                    rec2["internal_value"] = rec["internal_value"].at[s].set(
+                        leaf_value[bl])
+
+                    lv = leaf_value.at[bl].set(best["left_output"][bl])
+                    lv = lv.at[new_leaf].set(best["right_output"][bl])
+
+                    # children bookkeeping
+                    lb = leaf_begin.at[new_leaf].set(begin + left_cnt)
+                    lc_ = leaf_count.at[bl].set(left_cnt)
+                    lc_ = lc_.at[new_leaf].set(right_cnt)
+                    depth = leaf_depth[bl] + 1
+                    ld = leaf_depth.at[bl].set(depth)
+                    ld = ld.at[new_leaf].set(depth)
+                    lsg = leaf_sum_g.at[bl].set(best["left_g"][bl])
+                    lsg = lsg.at[new_leaf].set(best["right_g"][bl])
+                    lsh = leaf_sum_h.at[bl].set(best["left_h"][bl])
+                    lsh = lsh.at[new_leaf].set(best["right_h"][bl])
+
+                    # monotone constraint propagation
+                    if self._mono_any:
+                        mono = jnp.asarray(self.meta["monotone"],
+                                           jnp.int32)[f]
+                        mid = (best["left_output"][bl]
+                               + best["right_output"][bl]) / 2.0
+                        lmax = jnp.where(mono > 0,
+                                         jnp.minimum(leaf_maxc[bl], mid),
+                                         leaf_maxc[bl])
+                        rmin = jnp.where(mono > 0,
+                                         jnp.maximum(leaf_minc[bl], mid),
+                                         leaf_minc[bl])
+                        lmin = jnp.where(mono < 0,
+                                         jnp.maximum(leaf_minc[bl], mid),
+                                         leaf_minc[bl])
+                        rmax = jnp.where(mono < 0,
+                                         jnp.minimum(leaf_maxc[bl], mid),
+                                         leaf_maxc[bl])
+                        lminc = leaf_minc.at[bl].set(lmin)
+                        lminc = lminc.at[new_leaf].set(rmin)
+                        lmaxc = leaf_maxc.at[bl].set(lmax)
+                        lmaxc = lmaxc.at[new_leaf].set(rmax)
+                    else:
+                        lminc, lmaxc = leaf_minc, leaf_maxc
+
+                    # histogram: construct smaller child, subtract for larger
+                    smaller_is_left = left_cnt <= right_cnt
+                    sm_begin = jnp.where(smaller_is_left, begin,
+                                         begin + left_cnt)
+                    sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
+                    bk2 = self._bucket_index(sm_count, nbk)
+                    sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
+                                         grad, hess, sm_begin, sm_count)
+                    lg_hist = hist_store[bl] - sm_hist
+                    left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
+                    right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
+                    hs = hist_store.at[bl].set(left_hist)
+                    hs = hs.at[new_leaf].set(right_hist)
+
+                    # evaluate both children
+                    lbst = eval_leaf(left_hist, lsg[bl], lsh[bl], left_cnt,
+                                     lminc[bl], lmaxc[bl], depth)
+                    rbst = eval_leaf(right_hist, lsg[new_leaf],
+                                     lsh[new_leaf], right_cnt,
+                                     lminc[new_leaf], lmaxc[new_leaf], depth)
+                    best2 = dict(best)
+                    for k in best2:
+                        best2[k] = best2[k].at[bl].set(lbst[k])
+                        best2[k] = best2[k].at[new_leaf].set(rbst[k])
+
+                    return (new_indices, lb, lc_, lsg, lsh, ld, lminc, lmaxc,
+                            hs, best2, rec2, lv, n_splits + 1, done)
+
+                return lax.cond(do_split, apply, no_op, None)
+
+            (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+             leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
+             leaf_value, n_splits, done) = lax.fori_loop(
+                0, max(L - 1, 0), body, state)
+
+            record = TreeRecord(
+                num_splits=n_splits,
+                leaf=rec["leaf"], feature=rec["feature"],
+                threshold_bin=rec["threshold_bin"],
+                default_left=rec["default_left"], is_cat=rec["is_cat"],
+                cat_bitset=rec["cat_bitset"],
+                left_output=rec["left_output"],
+                right_output=rec["right_output"],
+                left_count=rec["left_count"], right_count=rec["right_count"],
+                gain=rec["gain"], internal_value=rec["internal_value"],
+                leaf_value=leaf_value, leaf_count_arr=leaf_count,
+                leaf_begin=leaf_begin, leaf_cnt_part=leaf_count)
+            return indices, record
+
+        return jax.jit(build, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              indices: jax.Array, root_count: int,
+              feature_mask: Optional[np.ndarray] = None
+              ) -> Tuple[jax.Array, TreeRecord]:
+        """Grow one tree; returns (new partition indices, TreeRecord).
+        `indices` must be padded so begin+bucket_size never overflows
+        (length n + pow2ceil(n))."""
+        root_padded = max(_pow2ceil(root_count), self.min_pad)
+        fn = self._build_cache.get(root_padded)
+        if fn is None:
+            fn = self._make_build_fn(root_padded)
+            self._build_cache[root_padded] = fn
+        if feature_mask is None:
+            fmask = jnp.ones(self.num_features, jnp.float32)
+        else:
+            fmask = jnp.asarray(feature_mask.astype(np.float32))
+        return fn(self.bins_dev, indices, grad, hess, jnp.int32(root_count),
+                  fmask)
+
+    # ------------------------------------------------------------------
+    def record_to_tree(self, rec_host, shrinkage: float = 1.0) -> Tree:
+        """Host-side conversion of a pulled TreeRecord into a full Tree
+        (bin thresholds -> real values via the BinMappers)."""
+        n_splits = int(rec_host.num_splits)
+        tree = Tree(self.cfg.num_leaves)
+        mt_code = {"none": 0, "zero": 1, "nan": 2}
+        for s in range(n_splits):
+            leaf = int(rec_host.leaf[s])
+            f = int(rec_host.feature[s])
+            mapper = self.mappers[f]
+            real_feature = int(self.ds.real_feature_idx[f])
+            mt = mt_code[mapper.missing_type]
+            if bool(rec_host.is_cat[s]):
+                words = rec_host.cat_bitset[s]
+                bins_list = [b for b in range(min(mapper.num_bin, 256))
+                             if (int(words[b // 32]) >> (b % 32)) & 1]
+                cats = [mapper.bin_2_categorical[b] for b in bins_list
+                        if b < len(mapper.bin_2_categorical)]
+                tree.split_categorical(
+                    leaf, f, real_feature, bins_list, cats,
+                    float(rec_host.left_output[s]),
+                    float(rec_host.right_output[s]),
+                    int(rec_host.left_count[s]),
+                    int(rec_host.right_count[s]),
+                    float(rec_host.gain[s]), mt,
+                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+            else:
+                thr_bin = int(rec_host.threshold_bin[s])
+                tree.split(
+                    leaf, f, real_feature, thr_bin,
+                    mapper.bin_to_value(thr_bin),
+                    float(rec_host.left_output[s]),
+                    float(rec_host.right_output[s]),
+                    int(rec_host.left_count[s]),
+                    int(rec_host.right_count[s]),
+                    float(rec_host.gain[s]), mt,
+                    bool(rec_host.default_left[s]),
+                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+        if shrinkage != 1.0:
+            tree.apply_shrinkage(shrinkage)
+        return tree
+
+
+@functools.partial(jax.jit, static_argnames=("padded",))
+def _masked_sums(indices, grad, hess, count, padded: int):
+    idx = lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
+    pos = jnp.arange(padded, dtype=jnp.int32)
+    valid = pos < count
+    safe = jnp.where(valid, idx, 0)
+    g = jnp.where(valid, grad[safe], 0.0)
+    h = jnp.where(valid, hess[safe], 0.0)
+    return g.sum(), h.sum()
+
+
+# ---------------------------------------------------------------------------
+# device score update from a TreeRecord
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def traversal_arrays(rec: TreeRecord, max_nodes: int):
+    """Build device traversal arrays (feature/threshold/children) from a
+    TreeRecord — the on-device analogue of `stack_trees`."""
+    left, right = record_to_children(rec.leaf, rec.num_splits, max_nodes)
+    return {
+        "feature": rec.feature, "threshold_bin": rec.threshold_bin,
+        "default_left": rec.default_left, "is_cat": rec.is_cat,
+        "cat_bitset": rec.cat_bitset, "left": left, "right": right,
+        "num_splits": rec.num_splits, "leaf_value": rec.leaf_value,
+    }
+
+
+@jax.jit
+def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt) -> jax.Array:
+    """[N] leaf index per row for one TreeRecord's tree over binned data.
+    nb/db/mt: per-feature num_bin/default_bin/missing arrays."""
+    n = bins.shape[0]
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        safe = jnp.maximum(node, 0)
+        feat = trav["feature"][safe]
+        fval = bins[jnp.arange(n), feat].astype(jnp.int32)
+        gl_num = numerical_goes_left(fval, trav["threshold_bin"][safe],
+                                     trav["default_left"][safe], mt[feat],
+                                     db[feat], nb[feat])
+        bitsets = trav["cat_bitset"][safe]  # [N, 8]
+        in_words = (fval >> 5) < 8
+        word = jnp.clip(fval >> 5, 0, 7)
+        w = jnp.take_along_axis(bitsets, word[:, None], axis=1)[:, 0]
+        gl_cat = (((w >> (fval & 31).astype(jnp.uint32)) & 1) != 0) & in_words
+        goes_left = jnp.where(trav["is_cat"][safe], gl_cat, gl_num)
+        nxt = jnp.where(goes_left, trav["left"][safe], trav["right"][safe])
+        return jnp.where(node >= 0, nxt, node)
+
+    node0 = jnp.where(trav["num_splits"] > 0, jnp.zeros(n, jnp.int32),
+                      jnp.full(n, -1, jnp.int32))
+    node = lax.while_loop(cond, body, node0)
+    return ~node
+
+
+@jax.jit
+def add_record_score(score_row: jax.Array, bins: jax.Array, trav: Dict,
+                     nb, db, mt, scale) -> jax.Array:
+    """score += scale * tree(x) for all rows via record traversal."""
+    leaves = traverse_record(bins, trav, nb, db, mt)
+    return score_row + scale * trav["leaf_value"][leaves]

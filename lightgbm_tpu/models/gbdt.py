@@ -26,11 +26,37 @@ from ..config import Config
 from ..io.dataset import Dataset
 from ..ops.metrics import Metric, create_metrics
 from ..ops.objectives import ObjectiveFunction, create_objective
+from ..ops.partition import init_partition, init_partition_from
 from ..ops.predict import TreePredictor, stack_trees, _predict_binned_stacked
+from .device_learner import (DeviceTreeLearner, TreeRecord, _pow2ceil,
+                             add_record_score, traversal_arrays)
 from .serial_learner import SerialTreeLearner
 from .tree import Tree
 
 K_EPSILON = 1e-15
+
+
+class LazyTree:
+    """A tree still living on device as a TreeRecord; materialized to a host
+    `Tree` only when the model surface needs it (export/predict)."""
+
+    __slots__ = ("record", "shrinkage", "bias", "learner", "max_nodes")
+
+    def __init__(self, record: TreeRecord, shrinkage: float, bias: float,
+                 learner: DeviceTreeLearner, max_nodes: int) -> None:
+        self.record = record
+        self.shrinkage = shrinkage
+        self.bias = bias
+        self.learner = learner
+        self.max_nodes = max_nodes
+
+    def materialize(self, rec_host=None) -> Tree:
+        rec = rec_host if rec_host is not None else jax.device_get(
+            self.record)
+        tree = self.learner.record_to_tree(rec, self.shrinkage)
+        if abs(self.bias) > K_EPSILON:
+            tree.add_bias(self.bias)
+        return tree
 
 
 class _ScoreUpdater:
@@ -70,6 +96,8 @@ class _ScoreUpdater:
 class GBDT:
     """reference `GBDT` (gbdt.h:41+)."""
 
+    _fused_ok = True  # DART/RF override: they reshape scores via host trees
+
     def __init__(self, cfg: Config, train_data: Dataset,
                  objective: Optional[ObjectiveFunction] = None) -> None:
         self.cfg = cfg
@@ -85,7 +113,26 @@ class GBDT:
         self.shrinkage_rate = cfg.learning_rate
         self.models: List[Tree] = []
         self.iter = 0
-        self.learner = SerialTreeLearner(cfg, train_data)
+        # fused on-device learner when the objective has no host-side leaf
+        # renewal hook; host-driven serial learner otherwise
+        self.use_fused = (
+            self._fused_ok
+            and not (self.objective is not None
+                     and getattr(self.objective, "is_renew_tree_output",
+                                 False))
+            and cfg.tree_learner == "serial")
+        if self.use_fused:
+            self.learner = DeviceTreeLearner(cfg, train_data)
+            self._n_pad = self.num_data + max(_pow2ceil(self.num_data),
+                                              cfg.tpu_min_pad)
+            self._trav_nb = jnp.asarray(self.learner.meta["num_bin"],
+                                        jnp.int32)
+            self._trav_db = jnp.asarray(self.learner.meta["default_bin"],
+                                        jnp.int32)
+            self._trav_mt = jnp.asarray(self.learner.meta["missing_type"],
+                                        jnp.int32)
+        else:
+            self.learner = SerialTreeLearner(cfg, train_data)
         self.train_score = _ScoreUpdater(
             self.num_data, self.num_tree_per_iteration,
             self._reshape_init_score(train_data))
@@ -113,6 +160,8 @@ class GBDT:
         if self.objective is not None and hasattr(self.objective, "need_train"):
             self._class_need_train = [self.objective.need_train] \
                 * self.num_tree_per_iteration
+        self._pending_numsplits: List[jax.Array] = []
+        self._valid_bins_dev: List[jax.Array] = []
 
     @staticmethod
     def _reshape_init_score(ds: Dataset) -> Optional[np.ndarray]:
@@ -127,11 +176,14 @@ class GBDT:
         self.valid_sets.append(ds)
         su = _ScoreUpdater(ds.num_data, self.num_tree_per_iteration,
                            self._reshape_init_score(ds))
+        if self.use_fused:
+            self._valid_bins_dev.append(jnp.asarray(ds.bins))
         # replay existing model onto the new valid set
         if self.models:
-            pred = TreePredictor(self.models)
+            models = self.materialized_models()
+            pred = TreePredictor(models)
             leaves = pred.predict_binned_leaves(ds.bins)
-            for i, tree in enumerate(self.models):
+            for i, tree in enumerate(models):
                 su.add_tree_by_leaves(leaves[i],
                                       tree.leaf_value[:tree.num_leaves],
                                       i % self.num_tree_per_iteration)
@@ -224,6 +276,9 @@ class GBDT:
         self._bagging(self.iter)
         gdev, hdev = self._post_bagging_gradients(gdev, hdev)
 
+        if self.use_fused:
+            return self._train_one_iter_fused(gdev, hdev, init_scores)
+
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
@@ -271,6 +326,90 @@ class GBDT:
         return False
 
     # ------------------------------------------------------------------
+    def _train_one_iter_fused(self, gdev, hdev, init_scores) -> bool:
+        """Fused path: whole-tree device programs, no mid-iteration host
+        syncs; empty-tree detection is deferred and batched."""
+        cfg = self.cfg
+        if self.bag_data_indices is not None:
+            idxs = init_partition_from(jnp.asarray(self.bag_data_indices),
+                                       self._n_pad)
+            count = self.bag_data_cnt
+        else:
+            idxs = init_partition(self.num_data, self._n_pad)
+            count = self.num_data
+        for k in range(self.num_tree_per_iteration):
+            # fresh column sample per tree, like SerialTreeLearner
+            fmask = self.learner.feature_mask()
+            if not self._class_need_train[k] \
+                    or self.train_data.num_features == 0:
+                # constant tree, mirroring the non-fused branch
+                # (gbdt.cpp:413-433)
+                t = Tree(2)
+                if len(self.models) < self.num_tree_per_iteration:
+                    if not self._class_need_train[k] \
+                            and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    t.as_constant_tree(output)
+                    if abs(output) > K_EPSILON:
+                        self.train_score.add_constant(output, k)
+                        for su in self.valid_scores:
+                            su.add_constant(output, k)
+                self.models.append(t)
+                continue
+            idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
+                                           fmask)
+            lazy = LazyTree(rec, self.shrinkage_rate, init_scores[k],
+                            self.learner, max(cfg.num_leaves - 1, 1))
+            self.models.append(lazy)
+            # device score updates via record traversal
+            trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
+            self.train_score.score = self.train_score.score.at[k].set(
+                add_record_score(self.train_score.score[k],
+                                 self.learner.bins_dev, trav, self._trav_nb,
+                                 self._trav_db, self._trav_mt,
+                                 jnp.float32(self.shrinkage_rate)))
+            for i, su in enumerate(self.valid_scores):
+                vb = self._valid_bins_dev[i]
+                su.score = su.score.at[k].set(
+                    add_record_score(su.score[k], vb, trav, self._trav_nb,
+                                     self._trav_db, self._trav_mt,
+                                     jnp.float32(self.shrinkage_rate)))
+            self._pending_numsplits.append(rec.num_splits)
+        self.iter += 1
+        # deferred empty-tree check: one batched pull every N iterations;
+        # trailing all-empty iterations are trimmed like the reference's
+        # immediate stop (gbdt.cpp:436-444)
+        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
+            ns = [int(x) for x in jax.device_get(self._pending_numsplits)]
+            self._pending_numsplits = []
+            k = self.num_tree_per_iteration
+            empty_trailing = 0
+            for it in range(len(ns) // k - 1, -1, -1):
+                if max(ns[it * k:(it + 1) * k]) == 0:
+                    empty_trailing += 1
+                else:
+                    break
+            if empty_trailing and len(self.models) > k:
+                drop = min(empty_trailing * k, len(self.models) - k)
+                del self.models[-drop:]
+                self.iter -= drop // k
+                return True
+        return False
+
+    def materialized_models(self) -> List[Tree]:
+        """Convert any LazyTree records to host Trees in ONE batched
+        device->host transfer."""
+        lazies = [(i, m) for i, m in enumerate(self.models)
+                  if isinstance(m, LazyTree)]
+        if lazies:
+            recs = jax.device_get([m.record for _, m in lazies])
+            for (i, m), rec in zip(lazies, recs):
+                self.models[i] = m.materialize(rec)
+        return self.models
+
+    # ------------------------------------------------------------------
     def _update_score(self, tree: Tree, class_id: int) -> None:
         """reference GBDT::UpdateScore (gbdt.cpp:487-506): train scores via
         one binned traversal (covers in-bag and out-of-bag rows alike), valid
@@ -288,6 +427,7 @@ class GBDT:
         """reference GBDT::RollbackOneIter (gbdt.cpp:450-466)."""
         if self.iter <= 0:
             return
+        self.materialized_models()
         start = len(self.models) - self.num_tree_per_iteration
         for k in range(self.num_tree_per_iteration):
             tree = self.models[start + k]
@@ -334,6 +474,7 @@ class GBDT:
     def predict_raw(self, X: np.ndarray,
                     num_iteration: Optional[int] = None) -> np.ndarray:
         """Raw scores for a dense matrix [N, F_total] -> [N, K]."""
+        self.materialized_models()
         trees = self._trees_for(num_iteration)
         n = len(X)
         k = self.num_tree_per_iteration

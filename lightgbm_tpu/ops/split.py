@@ -330,6 +330,24 @@ def make_split_finder(hyper: SplitHyper, feature_meta: Dict[str, np.ndarray],
                        jnp.where(use_bw, bw["lh"], fw["lh"]))
         lc = jnp.where(use_onehot, lc_oh_best,
                        jnp.where(use_bw, bw["lc"], fw["lc"]))
+        # device-side bitset over BINS for the chosen threshold set — used by
+        # the fused on-device learner's partition step (8 u32 words = 256
+        # bins). Bins are unique, so a sum equals the bitwise OR.
+        k_sel = (jnp.where(use_onehot, 1, t_sorted + 1))[:, None]  # [F,1]
+        sorted_sel = jnp.where(
+            use_bw[:, None],
+            (pos >= (n_elig[:, None] - k_sel)) & (pos < n_elig[:, None]),
+            pos < k_sel)
+        sel_bins = jnp.where(use_onehot[:, None],
+                             jnp.where(pos == t_oh[:, None], bins, -1),
+                             jnp.where(sorted_sel, order, -1))  # [F,B]
+        word_oh = (sel_bins >> 5)[:, :, None] == jnp.arange(8)[None, None, :]
+        bitval = jnp.where(sel_bins >= 0,
+                           jnp.uint32(1) << (sel_bins & 31).astype(jnp.uint32),
+                           jnp.uint32(0))
+        cat_bitset = jnp.sum(
+            jnp.where(word_oh, bitval[:, :, None], jnp.uint32(0)),
+            axis=1, dtype=jnp.uint32)  # [F, 8]
         # outputs use plain lambda_l2 for one-hot, lambda_l2 + cat_l2 for the
         # sorted path (feature_histogram.hpp:133,178,243-252)
         l2_eff = jnp.where(use_onehot, h.lambda_l2, l2c)
@@ -347,6 +365,7 @@ def make_split_finder(hyper: SplitHyper, feature_meta: Dict[str, np.ndarray],
             sort_order=order,
             n_elig=n_elig,
             use_onehot=use_onehot,
+            cat_bitset=cat_bitset,
         )
 
     @jax.jit
@@ -394,6 +413,10 @@ def make_split_finder(hyper: SplitHyper, feature_meta: Dict[str, np.ndarray],
             out["sort_order"] = cat["sort_order"]
             out["n_elig"] = cat["n_elig"]
             out["use_onehot"] = cat["use_onehot"]
+            out["cat_bitset"] = cat["cat_bitset"]
+        else:
+            out["cat_bitset"] = jnp.zeros((F, 8), jnp.uint32)
+        out["is_cat"] = is_cat[:, 0]
         out["best_feature"] = jnp.argmax(out["gain"]).astype(jnp.int32)
         return out
 
