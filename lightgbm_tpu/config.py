@@ -359,6 +359,11 @@ class Config:
         k = key.strip().lower()
         return _ALIASES.get(k, k)
 
+    def __post_init__(self) -> None:
+        if isinstance(self.task, dict):
+            raise TypeError("Config() takes dataclass fields, not a params "
+                            "dict — use Config.from_params({...})")
+
     @classmethod
     def from_params(cls, params: Optional[Dict[str, Any]] = None) -> "Config":
         cfg = cls()

@@ -18,6 +18,7 @@ from __future__ import annotations
 import io
 import json
 import struct
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -228,6 +229,13 @@ class Dataset:
 
         # --- full binned ingest
         used = self.real_feature_idx
+        for j in used:
+            m = self.mappers[j]
+            if m.bin_type == BIN_CATEGORICAL and m.num_bin > 256:
+                warnings.warn(
+                    f"categorical feature {j} has {m.num_bin} bins; only the "
+                    "256 most frequent categories are split candidates "
+                    "(device bitset limit)")
         max_nb = max((self.mappers[j].num_bin for j in used), default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
         bins = np.empty((n, len(used)), dtype=dtype)

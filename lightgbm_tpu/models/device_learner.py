@@ -28,8 +28,9 @@ from jax import lax
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..ops.histogram import NUM_HIST_STATS, _chunk_histogram
-from ..ops.partition import categorical_goes_left, numerical_goes_left
+from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered
+from ..ops.partition import (categorical_goes_left, numerical_goes_left,
+                             split_partition)
 from ..ops.split import SplitHyper, make_split_finder
 from .tree import Tree
 
@@ -90,10 +91,26 @@ def record_to_children(leaf_rec: jax.Array, num_splits: jax.Array,
 
 
 class DeviceTreeLearner:
-    """Drop-in replacement for SerialTreeLearner with zero mid-tree syncs."""
+    """Drop-in replacement for SerialTreeLearner with zero mid-tree syncs.
 
-    def __init__(self, cfg: Config, dataset: Dataset) -> None:
+    With ``axis_name`` set, the same whole-tree program becomes the
+    data-parallel learner (reference `DataParallelTreeLearner`,
+    `data_parallel_tree_learner.cpp`): rows are sharded over a mesh axis,
+    local histograms are `lax.psum`-reduced (the XLA/ICI analogue of
+    `Network::ReduceScatter` + best-split allreduce — since every shard then
+    holds the GLOBAL histogram, the best split is computed redundantly and
+    identically on all shards, so no separate `SyncUpGlobalBestSplit` is
+    needed), and leaf counts split into a LOCAL set driving the per-shard
+    partition and a GLOBAL set driving split decisions (the reference's
+    `global_data_count_in_leaf_`, data_parallel_tree_learner.cpp:251-257).
+    Collectives sit at uniform program points (outside `lax.switch`
+    branches) so shards never diverge on collective schedules.
+    """
+
+    def __init__(self, cfg: Config, dataset: Dataset,
+                 axis_name: Optional[str] = None) -> None:
         self.cfg = cfg
+        self.axis_name = axis_name
         self.ds = dataset
         self.n = dataset.num_data
         self.num_features = dataset.num_features
@@ -101,7 +118,8 @@ class DeviceTreeLearner:
         self.meta = meta
         self.max_bin_global = int(meta["num_bin"].max()) \
             if len(meta["num_bin"]) else 2
-        self.bins_dev = jnp.asarray(dataset.bins)
+        self._bins_dev = None  # lazy: the data-parallel wrapper never
+        # materializes this second (replicated) device copy of the bins
         self.hyper = SplitHyper.from_config(cfg)
         self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
         self.mappers = dataset.used_mappers()
@@ -116,6 +134,19 @@ class DeviceTreeLearner:
         self._mono_any = bool(np.any(meta["monotone"] != 0))
         self._build_cache: Dict[int, callable] = {}
         self._depth_limit = cfg.max_depth if cfg.max_depth > 0 else 1 << 30
+
+    @property
+    def bins_dev(self) -> jax.Array:
+        if self._bins_dev is None:
+            self._bins_dev = jnp.asarray(self.ds.bins)
+        return self._bins_dev
+
+    def add_score(self, score_row: jax.Array, trav: Dict,
+                  scale: float) -> jax.Array:
+        """score += scale * tree(x) over the training bins."""
+        return add_record_score(score_row, self.bins_dev, trav, self._nb_dev,
+                                self._db_dev, self._mt_dev,
+                                jnp.float32(scale))
 
     # ------------------------------------------------------------------
     def feature_mask(self) -> Optional[np.ndarray]:
@@ -168,50 +199,27 @@ class DeviceTreeLearner:
                 pos = jnp.arange(size, dtype=jnp.int32)
                 valid = pos < count
                 safe = jnp.where(valid, idx, 0)
-                rows = bins[safe].astype(jnp.int32)
-                payload = jnp.stack(
-                    [jnp.where(valid, grad[safe], 0.0),
-                     jnp.where(valid, hess[safe], 0.0),
-                     valid.astype(jnp.float32)], axis=1)
-                if size <= chunk:
-                    return _chunk_histogram(rows, payload, B, precision)
-                n_chunks = size // chunk
-                rows_c = rows.reshape(n_chunks, chunk, F)
-                pay_c = payload.reshape(n_chunks, chunk, NUM_HIST_STATS)
-
-                def body(acc, xs):
-                    r, p = xs
-                    return acc + _chunk_histogram(r, p, B, precision), None
-
-                init = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
-                acc, _ = lax.scan(body, init, (rows_c, pay_c))
-                return acc
+                return histogram_from_gathered(bins[safe], grad[safe],
+                                               hess[safe], valid, B, chunk,
+                                               precision)
             return fn
 
         def part_bucket(size):
             def fn(bins_col, indices, begin, count, threshold, default_left,
                    missing_type, default_bin, num_bin, is_cat, bitset):
-                idx = lax.dynamic_slice(indices, (begin,), (size,))
-                pos = jnp.arange(size, dtype=jnp.int32)
-                valid = pos < count
-                safe = jnp.where(valid, idx, 0)
-                b = bins_col[safe].astype(jnp.int32)
-                gl_num = numerical_goes_left(b, threshold, default_left,
-                                             missing_type, default_bin,
-                                             num_bin)
-                gl_cat = categorical_goes_left(b, bitset)
-                goes_left = jnp.where(is_cat, gl_cat, gl_num)
-                key = jnp.where(valid, jnp.where(goes_left, 0, 1), 2)
-                order = jnp.argsort(key.astype(jnp.int32), stable=True)
-                new_slice = idx[order]
-                left_count = jnp.sum((key == 0).astype(jnp.int32))
-                new_indices = lax.dynamic_update_slice(indices, new_slice,
-                                                       (begin,))
-                return new_indices, left_count
+                return split_partition(indices, bins_col, begin, count, size,
+                                       threshold, default_left, missing_type,
+                                       default_bin, num_bin, is_cat, bitset)
             return fn
 
         hist_fns = [hist_bucket(s) for s in buckets]
         part_fns = [part_bucket(s) for s in buckets]
+        axis = self.axis_name
+
+        def _gsum(x):
+            """Cross-shard sum — identity in serial mode. MUST be called at
+            uniform program points (never inside a lax.switch branch)."""
+            return lax.psum(x, axis) if axis is not None else x
 
         def build(bins, indices, grad, hess, root_count, feature_mask_f32):
             # ---------- state ----------
@@ -259,10 +267,15 @@ class DeviceTreeLearner:
             root_hist = lax.switch(
                 bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
                 root_count)
+            root_hist = _gsum(root_hist)
             hist_store = hist_store.at[0].set(root_hist)
-            # root grad/hess sums by direct reduction
+            # root grad/hess sums by direct reduction (data-parallel: the
+            # root-sums allreduce, data_parallel_tree_learner.cpp:120-145)
             root_g, root_h = _masked_sums(indices, grad, hess, root_count,
                                           root_padded)
+            root_g, root_h = _gsum(root_g), _gsum(root_h)
+            root_count_g = _gsum(root_count)
+            leaf_count_glob = jnp.zeros(L, jnp.int32).at[0].set(root_count_g)
             leaf_sum_g = jnp.zeros(L, jnp.float32).at[0].set(root_g)
             leaf_sum_h = jnp.zeros(L, jnp.float32).at[0].set(root_h)
 
@@ -289,17 +302,19 @@ class DeviceTreeLearner:
                     "right_output": out["right_output"][f],
                 }
 
-            root_best = eval_leaf(root_hist, root_g, root_h, root_count,
+            root_best = eval_leaf(root_hist, root_g, root_h, root_count_g,
                                   jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
                                   jnp.int32(0))
             best = {k: best[k].at[0].set(root_best[k]) for k in best}
 
-            state = (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+            state = (indices, leaf_begin, leaf_count, leaf_count_glob,
+                     leaf_sum_g, leaf_sum_h,
                      leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
                      leaf_value, jnp.int32(0), jnp.asarray(False))
 
             def body(s, state):
-                (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+                (indices, leaf_begin, leaf_count, leaf_count_glob,
+                 leaf_sum_g, leaf_sum_h,
                  leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
                  leaf_value, n_splits, done) = state
                 bl = jnp.argmax(best["gain"]).astype(jnp.int32)
@@ -307,7 +322,8 @@ class DeviceTreeLearner:
                 do_split = gain_ok & ~done
 
                 def no_op(_):
-                    return (indices, leaf_begin, leaf_count, leaf_sum_g,
+                    return (indices, leaf_begin, leaf_count, leaf_count_glob,
+                            leaf_sum_g,
                             leaf_sum_h, leaf_depth, leaf_minc, leaf_maxc,
                             hist_store, best, rec, leaf_value, n_splits,
                             jnp.asarray(True))
@@ -326,6 +342,10 @@ class DeviceTreeLearner:
                         bk, part_fns, bins[:, f], indices, begin, count, thr,
                         dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bitset)
                     right_cnt = count - left_cnt
+                    # GLOBAL child counts come from the (already psum-reduced)
+                    # histogram's count channel — exact integers in f32
+                    left_cnt_g = best["left_c"][bl]
+                    right_cnt_g = best["right_c"][bl]
 
                     # record
                     rec2 = dict(rec)
@@ -339,9 +359,10 @@ class DeviceTreeLearner:
                         best["left_output"][bl])
                     rec2["right_output"] = rec["right_output"].at[s].set(
                         best["right_output"][bl])
-                    rec2["left_count"] = rec["left_count"].at[s].set(left_cnt)
+                    rec2["left_count"] = rec["left_count"].at[s].set(
+                        left_cnt_g)
                     rec2["right_count"] = rec["right_count"].at[s].set(
-                        right_cnt)
+                        right_cnt_g)
                     rec2["gain"] = rec["gain"].at[s].set(best["gain"][bl])
                     rec2["internal_value"] = rec["internal_value"].at[s].set(
                         leaf_value[bl])
@@ -353,6 +374,8 @@ class DeviceTreeLearner:
                     lb = leaf_begin.at[new_leaf].set(begin + left_cnt)
                     lc_ = leaf_count.at[bl].set(left_cnt)
                     lc_ = lc_.at[new_leaf].set(right_cnt)
+                    lcg = leaf_count_glob.at[bl].set(left_cnt_g)
+                    lcg = lcg.at[new_leaf].set(right_cnt_g)
                     depth = leaf_depth[bl] + 1
                     ld = leaf_depth.at[bl].set(depth)
                     ld = ld.at[new_leaf].set(depth)
@@ -386,37 +409,44 @@ class DeviceTreeLearner:
                     else:
                         lminc, lmaxc = leaf_minc, leaf_maxc
 
-                    # histogram: construct smaller child, subtract for larger
-                    smaller_is_left = left_cnt <= right_cnt
+                    # histogram: construct smaller child, subtract for larger.
+                    # "Smaller" is decided on GLOBAL counts so every shard
+                    # histograms the same child (the reference uses
+                    # GetGlobalDataCountInLeaf the same way,
+                    # data_parallel_tree_learner.cpp:198-220); each shard
+                    # gathers its LOCAL slice of that child.
+                    smaller_is_left = left_cnt_g <= right_cnt_g
                     sm_begin = jnp.where(smaller_is_left, begin,
                                          begin + left_cnt)
                     sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
                     bk2 = self._bucket_index(sm_count, nbk)
                     sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
                                          grad, hess, sm_begin, sm_count)
+                    sm_hist = _gsum(sm_hist)
                     lg_hist = hist_store[bl] - sm_hist
                     left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
                     right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
                     hs = hist_store.at[bl].set(left_hist)
                     hs = hs.at[new_leaf].set(right_hist)
 
-                    # evaluate both children
-                    lbst = eval_leaf(left_hist, lsg[bl], lsh[bl], left_cnt,
+                    # evaluate both children (global counts)
+                    lbst = eval_leaf(left_hist, lsg[bl], lsh[bl], left_cnt_g,
                                      lminc[bl], lmaxc[bl], depth)
                     rbst = eval_leaf(right_hist, lsg[new_leaf],
-                                     lsh[new_leaf], right_cnt,
+                                     lsh[new_leaf], right_cnt_g,
                                      lminc[new_leaf], lmaxc[new_leaf], depth)
                     best2 = dict(best)
                     for k in best2:
                         best2[k] = best2[k].at[bl].set(lbst[k])
                         best2[k] = best2[k].at[new_leaf].set(rbst[k])
 
-                    return (new_indices, lb, lc_, lsg, lsh, ld, lminc, lmaxc,
-                            hs, best2, rec2, lv, n_splits + 1, done)
+                    return (new_indices, lb, lc_, lcg, lsg, lsh, ld, lminc,
+                            lmaxc, hs, best2, rec2, lv, n_splits + 1, done)
 
                 return lax.cond(do_split, apply, no_op, None)
 
-            (indices, leaf_begin, leaf_count, leaf_sum_g, leaf_sum_h,
+            (indices, leaf_begin, leaf_count, leaf_count_glob,
+             leaf_sum_g, leaf_sum_h,
              leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
              leaf_value, n_splits, done) = lax.fori_loop(
                 0, max(L - 1, 0), body, state)
@@ -431,13 +461,25 @@ class DeviceTreeLearner:
                 right_output=rec["right_output"],
                 left_count=rec["left_count"], right_count=rec["right_count"],
                 gain=rec["gain"], internal_value=rec["internal_value"],
-                leaf_value=leaf_value, leaf_count_arr=leaf_count,
+                leaf_value=leaf_value, leaf_count_arr=leaf_count_glob,
                 leaf_begin=leaf_begin, leaf_cnt_part=leaf_count)
             return indices, record
 
+        if self.axis_name is not None:
+            return build  # caller wraps in shard_map + jit
         return jax.jit(build, donate_argnums=(1,))
 
     # ------------------------------------------------------------------
+    def init_root_partition(self, bag_indices, bag_cnt: int):
+        """Fresh root partition for one boosting iteration (the analogue of
+        `DataPartition::Init`, data_partition.hpp:59)."""
+        from ..ops.partition import init_partition, init_partition_from
+        n_pad = self.n + max(_pow2ceil(self.n), self.min_pad)
+        if bag_indices is not None:
+            return (init_partition_from(jnp.asarray(bag_indices), n_pad),
+                    bag_cnt)
+        return init_partition(self.n, n_pad), self.n
+
     def train(self, grad: jax.Array, hess: jax.Array,
               indices: jax.Array, root_count: int,
               feature_mask: Optional[np.ndarray] = None

@@ -15,7 +15,6 @@ once per eval.
 """
 from __future__ import annotations
 
-import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,9 +25,8 @@ from ..config import Config
 from ..io.dataset import Dataset
 from ..ops.metrics import Metric, create_metrics
 from ..ops.objectives import ObjectiveFunction, create_objective
-from ..ops.partition import init_partition, init_partition_from
 from ..ops.predict import TreePredictor, stack_trees, _predict_binned_stacked
-from .device_learner import (DeviceTreeLearner, TreeRecord, _pow2ceil,
+from .device_learner import (DeviceTreeLearner, TreeRecord,
                              add_record_score, traversal_arrays)
 from .serial_learner import SerialTreeLearner
 from .tree import Tree
@@ -120,11 +118,17 @@ class GBDT:
             and not (self.objective is not None
                      and getattr(self.objective, "is_renew_tree_output",
                                  False))
-            and cfg.tree_learner == "serial")
+            and cfg.tree_learner in ("serial", "data", "feature", "voting"))
         if self.use_fused:
-            self.learner = DeviceTreeLearner(cfg, train_data)
-            self._n_pad = self.num_data + max(_pow2ceil(self.num_data),
-                                              cfg.tpu_min_pad)
+            if cfg.tree_learner == "serial" or len(jax.devices()) == 1:
+                self.learner = DeviceTreeLearner(cfg, train_data)
+            else:
+                # rows sharded over the device mesh; feature/voting variants
+                # currently run the data-parallel strategy (same results,
+                # different comms pattern) until their dedicated sharding
+                # lands
+                from ..parallel.data_parallel import DataParallelTreeLearner
+                self.learner = DataParallelTreeLearner(cfg, train_data)
             self._trav_nb = jnp.asarray(self.learner.meta["num_bin"],
                                         jnp.int32)
             self._trav_db = jnp.asarray(self.learner.meta["default_bin"],
@@ -300,21 +304,9 @@ class GBDT:
                 self._update_score(new_tree, k)
                 if abs(init_scores[k]) > K_EPSILON:
                     new_tree.add_bias(init_scores[k])
+                self.models.append(new_tree)
             else:
-                # constant tree carrying the init score (gbdt.cpp:413-433)
-                if len(self.models) < self.num_tree_per_iteration:
-                    output = 0.0
-                    if not self._class_need_train[k]:
-                        if self.objective is not None:
-                            output = self.objective.boost_from_score(k)
-                    else:
-                        output = init_scores[k]
-                    new_tree.as_constant_tree(output)
-                    if abs(output) > K_EPSILON:
-                        self.train_score.add_constant(output, k)
-                        for su in self.valid_scores:
-                            su.add_constant(output, k)
-            self.models.append(new_tree)
+                self._append_constant_tree(k, init_scores)
 
         if not should_continue:
             # keep the constant first iteration, drop later no-split ones
@@ -325,51 +317,54 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _append_constant_tree(self, k: int, init_scores) -> Tree:
+        """Constant tree carrying the init score (gbdt.cpp:413-433): only the
+        first iteration's constant trees hold an output; later no-split
+        iterations append blanks."""
+        t = Tree(2)
+        if len(self.models) < self.num_tree_per_iteration:
+            if not self._class_need_train[k] and self.objective is not None:
+                output = self.objective.boost_from_score(k)
+            else:
+                output = init_scores[k]
+            t.as_constant_tree(output)
+            if abs(output) > K_EPSILON:
+                self.train_score.add_constant(output, k)
+                for su in self.valid_scores:
+                    su.add_constant(output, k)
+        self.models.append(t)
+        return t
+
     # ------------------------------------------------------------------
     def _train_one_iter_fused(self, gdev, hdev, init_scores) -> bool:
         """Fused path: whole-tree device programs, no mid-iteration host
         syncs; empty-tree detection is deferred and batched."""
         cfg = self.cfg
-        if self.bag_data_indices is not None:
-            idxs = init_partition_from(jnp.asarray(self.bag_data_indices),
-                                       self._n_pad)
-            count = self.bag_data_cnt
-        else:
-            idxs = init_partition(self.num_data, self._n_pad)
-            count = self.num_data
+        idxs, count = self.learner.init_root_partition(
+            self.bag_data_indices, self.bag_data_cnt)
+        any_trained = False
         for k in range(self.num_tree_per_iteration):
             # fresh column sample per tree, like SerialTreeLearner
             fmask = self.learner.feature_mask()
             if not self._class_need_train[k] \
                     or self.train_data.num_features == 0:
-                # constant tree, mirroring the non-fused branch
-                # (gbdt.cpp:413-433)
-                t = Tree(2)
-                if len(self.models) < self.num_tree_per_iteration:
-                    if not self._class_need_train[k] \
-                            and self.objective is not None:
-                        output = self.objective.boost_from_score(k)
-                    else:
-                        output = init_scores[k]
-                    t.as_constant_tree(output)
-                    if abs(output) > K_EPSILON:
-                        self.train_score.add_constant(output, k)
-                        for su in self.valid_scores:
-                            su.add_constant(output, k)
-                self.models.append(t)
+                self._append_constant_tree(k, init_scores)
+                # keep exactly k pending entries per iteration so the
+                # batched trim and rollback arithmetic stay aligned
+                self._pending_numsplits.append(0)
                 continue
+            any_trained = True
             idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
                                            fmask)
             lazy = LazyTree(rec, self.shrinkage_rate, init_scores[k],
                             self.learner, max(cfg.num_leaves - 1, 1))
             self.models.append(lazy)
-            # device score updates via record traversal
+            # device score updates via record traversal (sharded over the
+            # mesh in data-parallel mode)
             trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
             self.train_score.score = self.train_score.score.at[k].set(
-                add_record_score(self.train_score.score[k],
-                                 self.learner.bins_dev, trav, self._trav_nb,
-                                 self._trav_db, self._trav_mt,
-                                 jnp.float32(self.shrinkage_rate)))
+                self.learner.add_score(self.train_score.score[k], trav,
+                                       self.shrinkage_rate))
             for i, su in enumerate(self.valid_scores):
                 vb = self._valid_bins_dev[i]
                 su.score = su.score.at[k].set(
@@ -377,6 +372,15 @@ class GBDT:
                                      self._trav_db, self._trav_mt,
                                      jnp.float32(self.shrinkage_rate)))
             self._pending_numsplits.append(rec.num_splits)
+        if not any_trained:
+            # nothing trainable this iteration: mirror the non-fused
+            # immediate stop (gbdt.cpp:436-444) — keep a constant first
+            # iteration, drop later no-op ones
+            k = self.num_tree_per_iteration
+            del self._pending_numsplits[-k:]
+            if len(self.models) > k:
+                del self.models[-k:]
+            return True
         self.iter += 1
         # deferred empty-tree check: one batched pull every N iterations;
         # trailing all-empty iterations are trimmed like the reference's
@@ -427,6 +431,10 @@ class GBDT:
         """reference GBDT::RollbackOneIter (gbdt.cpp:450-466)."""
         if self.iter <= 0:
             return
+        # drop the rolled-back iteration's deferred empty-tree records so the
+        # batched trim stays aligned with self.models
+        if self._pending_numsplits:
+            del self._pending_numsplits[-self.num_tree_per_iteration:]
         self.materialized_models()
         start = len(self.models) - self.num_tree_per_iteration
         for k in range(self.num_tree_per_iteration):

@@ -36,6 +36,9 @@ from jax import lax
 
 K_EPSILON = 1e-15
 NEG_INF = -jnp.inf
+# device category bitsets are 8 u32 words; categorical split candidates are
+# limited to the first (most frequent) 256 category bins
+CAT_BITSET_BINS = 256
 
 
 class SplitHyper(NamedTuple):
@@ -234,7 +237,12 @@ def make_split_finder(hyper: SplitHyper, feature_meta: Dict[str, np.ndarray],
         c = hist[..., 2]
         # used_bin = num_bin - 1 + (missing == none)  (:129-130)
         used_bin = nb - 1 + (mt == 0).astype(jnp.int32)
-        cand = bins < used_bin
+        # the device-side category bitset spans 8 u32 words = 256 bins
+        # (mirroring the reference GPU path's <=256-bins-per-group
+        # constraint, dataset.cpp:78); bins beyond it — categories rarer
+        # than the 256 most frequent — are not split candidates, keeping
+        # the chosen-left stats consistent with the partition routing
+        cand = (bins < used_bin) & (bins < CAT_BITSET_BINS)
 
         # ---- one-hot: left = single bin t (:138-169); uses plain lambda_l2
         lh_oh = hs + K_EPSILON

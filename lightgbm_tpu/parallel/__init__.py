@@ -1,0 +1,5 @@
+"""Distributed tree learning over `jax.sharding.Mesh` — the XLA-collective
+replacement for the reference's `src/network/` + parallel tree learners."""
+from .data_parallel import DataParallelTreeLearner, default_mesh
+
+__all__ = ["DataParallelTreeLearner", "default_mesh"]

@@ -1,0 +1,101 @@
+"""Distributed tree learners over a virtual 8-device CPU mesh.
+
+The TPU analogue of the reference's localhost-socket multi-rank testing
+(SURVEY.md §4): `conftest.py` forces
+`--xla_force_host_platform_device_count=8`, and these tests assert the
+data-parallel learner (rows sharded, psum-reduced histograms) reproduces the
+serial learner's model.
+"""
+import numpy as np
+import pytest
+
+import jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.parallel.data_parallel import DataParallelTreeLearner
+
+
+def _make_problem(n=1200, f=8, seed=3, classification=True):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float64)
+    margin = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] - 0.5 * np.abs(X[:, 3])
+    if classification:
+        y = (margin + 0.2 * rng.standard_normal(n) > 0).astype(np.float64)
+    else:
+        y = margin + 0.1 * rng.standard_normal(n)
+    return X, y
+
+
+def _train(X, y, params, num_round=8):
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    booster = lgb.Booster(params=params, train_set=ds)
+    for _ in range(num_round):
+        booster.update()
+    return booster
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_data_parallel_matches_serial(objective):
+    assert len(jax.devices()) == 8, "conftest must force an 8-device mesh"
+    X, y = _make_problem(classification=objective == "binary")
+    base = {"objective": objective, "num_leaves": 15, "learning_rate": 0.2,
+            "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+            "gpu_use_dp": True}  # f32 hists: tie-free comparison
+    b_serial = _train(X, y, dict(base, tree_learner="serial"))
+    b_data = _train(X, y, dict(base, tree_learner="data"))
+    assert isinstance(b_data._gbdt.learner, DataParallelTreeLearner)
+    assert b_data._gbdt.learner.nd == 8
+    p_serial = b_serial.predict(X, raw_score=True)
+    p_data = b_data.predict(X, raw_score=True)
+    np.testing.assert_allclose(p_data, p_serial, rtol=1e-4, atol=1e-5)
+
+
+def test_data_parallel_uneven_rows():
+    # n not divisible by 8: last shard is padded
+    X, y = _make_problem(n=1021)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tree_learner": "data", "metric": "none", "gpu_use_dp": True,
+              "min_data_in_leaf": 3}
+    b = _train(X, y, params, num_round=5)
+    pred = b.predict(X)
+    y_hat = (pred > 0.5).astype(np.float64)
+    assert (y_hat == y).mean() > 0.8
+
+
+def test_data_parallel_with_bagging_and_feature_fraction():
+    X, y = _make_problem(n=1500)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "metric": "none", "bagging_fraction": 0.6, "bagging_freq": 1,
+            "feature_fraction": 0.8, "bagging_seed": 11, "gpu_use_dp": True,
+            "min_data_in_leaf": 5}
+    b_serial = _train(X, y, dict(base, tree_learner="serial"))
+    b_data = _train(X, y, dict(base, tree_learner="data"))
+    p_serial = b_serial.predict(X, raw_score=True)
+    p_data = b_data.predict(X, raw_score=True)
+    np.testing.assert_allclose(p_data, p_serial, rtol=1e-4, atol=1e-5)
+
+
+def test_data_parallel_num_machines_subset():
+    # num_machines=2 limits the mesh to 2 of the 8 devices
+    X, y = _make_problem(n=600)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tree_learner": "data", "num_machines": 2, "metric": "none",
+              "gpu_use_dp": True, "min_data_in_leaf": 3}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    booster = lgb.Booster(params=params, train_set=ds)
+    assert booster._gbdt.learner.nd == 2
+    booster.update()
+    assert booster._gbdt.iter == 1
+
+
+def test_dryrun_multichip_contract():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", os.path.join(os.path.dirname(__file__), os.pardir,
+                                        "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
