@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark: HIGGS-shaped GBDT training throughput on the current backend.
+
+Mirrors the reference's headline experiment (docs/Experiments.rst:106):
+HIGGS 10.5M rows x 28 dense numerical features, 500 boosting iterations,
+255 leaves, max_bin=255, binary logloss objective -> 238.5 s wall-clock on
+2x E5-2670v3. Here the data is synthetic (same shape/sparsity profile: dense
+floats, learnable nonlinear decision boundary) because the 2.6 GB HIGGS csv
+is not vendored; the measured quantity — boosting-iteration throughput on a
+binned 10.5Mx28 dataset at 255 leaves — is the same hot loop.
+
+Prints ONE JSON line:
+  {"metric": "higgs_synth_500iter_s", "value": <projected seconds for 500
+   iters>, "unit": "s", "vs_baseline": <238.5 / value>}
+so vs_baseline > 1.0 means faster than the reference CPU number.
+
+Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured iterations),
+BENCH_WARMUP, BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU smoke config).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import lightgbm_tpu as lgb  # noqa: E402
+
+BASELINE_S = 238.5  # docs/Experiments.rst:106, LightGBM CPU, 16 threads
+BASELINE_ITERS = 500
+
+
+def synth_higgs(n: int, f: int, seed: int = 7):
+    """Dense float features with a noisy nonlinear boundary (HIGGS-like:
+    kinematic features + derived high-level features)."""
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f), dtype=np.float32)
+    # derived features: products/abs, like HIGGS high-level columns
+    k = min(7, f // 4)
+    for j in range(k):
+        X[:, f - 1 - j] = np.abs(X[:, 2 * j] * X[:, 2 * j + 1]) \
+            + 0.1 * X[:, f - 1 - j]
+    w = rng.standard_normal(f).astype(np.float32) / np.sqrt(f)
+    margin = X @ w + 0.5 * np.sin(X[:, 0] * 2.0) * X[:, 1] \
+        - 0.4 * (np.abs(X[:, 2]) > 1.0)
+    p = 1.0 / (1.0 + np.exp(-margin))
+    y = (rng.random(n) < p).astype(np.int8)
+    return X, y
+
+
+def main() -> None:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n = int(os.environ.get("BENCH_ROWS", 20_000 if smoke else 10_500_000))
+    f = int(os.environ.get("BENCH_FEATURES", 28))
+    iters = int(os.environ.get("BENCH_ITERS", 5 if smoke else 40))
+    warmup = int(os.environ.get("BENCH_WARMUP", 2 if smoke else 8))
+    leaves = int(os.environ.get("BENCH_LEAVES", 31 if smoke else 255))
+
+    t0 = time.perf_counter()
+    X, y = synth_higgs(n, f)
+    t_gen = time.perf_counter() - t0
+
+    params = {
+        "objective": "binary",
+        "num_leaves": leaves,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 20,
+        "verbosity": -1,
+        "metric": "none",
+    }
+    t0 = time.perf_counter()
+    train_set = lgb.Dataset(X, label=y, params=params).construct()
+    t_bin = time.perf_counter() - t0
+
+    booster = lgb.Booster(params=params, train_set=train_set)
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        booster.update()
+    # force all queued device work to finish before starting the clock
+    np.asarray(booster._gbdt.train_score.score.block_until_ready())
+    t_warm = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.update()
+    np.asarray(booster._gbdt.train_score.score.block_until_ready())
+    t_meas = time.perf_counter() - t0
+
+    per_iter = t_meas / iters
+    projected = per_iter * BASELINE_ITERS
+    print(json.dumps({
+        "metric": "higgs_synth_500iter_s",
+        "value": round(projected, 2),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / projected, 3),
+    }))
+    print(f"# rows={n} features={f} leaves={leaves} "
+          f"gen={t_gen:.1f}s bin={t_bin:.1f}s warmup({warmup})={t_warm:.1f}s "
+          f"measured({iters})={t_meas:.1f}s per_iter={per_iter * 1e3:.1f}ms",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
