@@ -222,9 +222,14 @@ class Booster:
     # ------------------------------------------------------------------
     def _init_from_string(self, text: str) -> None:
         self._loaded = load_model_from_string(text)
-        self.params = dict(self._loaded.get("params", {}))
+        loaded_params = dict(self._loaded.get("params", {}))
+        self.params = {**loaded_params, **self.params}
+        # keep the model file's training params (regularization etc.) so
+        # downstream refit/predict reuse them (reference GBDT::RefitTree
+        # runs under the session config)
         self._cfg = Config.from_params(
-            {"objective": self._loaded["objective"].split(" ")[0],
+            {**self.params,
+             "objective": self._loaded["objective"].split(" ")[0],
              "num_class": self._loaded["num_class"]})
 
     @property
@@ -265,6 +270,61 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        return self
+
+    # ------------------------------------------------------------------
+    def refit(self, data, label, decay_rate: float = 0.9,
+              leaf_preds=None, **kwargs) -> "Booster":
+        """Refit existing tree structures to new data (reference
+        basic.py:2337 -> `GBDT::RefitTree` gbdt.cpp:297-320 ->
+        `FitByExistingTree` serial_tree_learner.cpp:239-269):
+        ``leaf_output = decay_rate * old + (1 - decay_rate) * new`` where
+        ``new`` is the closed-form leaf output of the new data's grad/hess
+        summed per (fixed) leaf assignment."""
+        import jax.numpy as jnp
+
+        from .io.dataset import Metadata
+        from .ops.split import threshold_l1_host as _thl1
+
+        trees = self.trees
+        if not trees:
+            raise LightGBMError("No trees to refit")
+        X = _to_matrix(data)
+        label = np.asarray(label, np.float64).reshape(-1)
+        n = len(X)
+        k = self.num_tree_per_iteration
+        if leaf_preds is None:
+            # all trees, regardless of best_iteration (reference refit
+            # predicts with num_iteration=-1, basic.py:2362)
+            leaf_preds = predict_raw_values(trees, X, leaf_index=True)
+        leaf_preds = np.asarray(leaf_preds, np.int64).reshape(n, len(trees))
+        cfg = self._cfg
+        objective = create_objective(cfg)
+        if objective is None:
+            raise LightGBMError("Cannot refit due to null objective function.")
+        md = Metadata(n)
+        md.set_label(label)
+        objective.init(md, n)
+        scores = np.zeros((k, n), np.float64)
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        mds = cfg.max_delta_step
+        for it in range(len(trees) // k):
+            g, h = objective.get_gradients(jnp.asarray(scores, jnp.float32))
+            g = np.asarray(g, np.float64)
+            h = np.asarray(h, np.float64)
+            for tid in range(k):
+                tree = trees[it * k + tid]
+                lp = leaf_preds[:, it * k + tid]
+                nl = tree.num_leaves
+                sg = np.bincount(lp, weights=g[tid], minlength=nl)[:nl]
+                sh = np.bincount(lp, weights=h[tid], minlength=nl)[:nl]
+                out = -_thl1(sg, l1) / (sh + l2 + 1e-15)
+                if mds > 0:
+                    out = np.clip(out, -mds, mds)
+                new_vals = (decay_rate * tree.leaf_value[:nl]
+                            + (1.0 - decay_rate) * out * tree.shrinkage)
+                tree.leaf_value[:nl] = new_vals
+                scores[tid] += new_vals[lp]
         return self
 
     @property

@@ -45,7 +45,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for alias in ("early_stopping_round", "early_stopping_rounds",
                   "early_stopping"):
         if alias in params:
-            early_stopping_rounds = params.pop(alias)
+            v = params.pop(alias)
+            early_stopping_rounds = None if v is None else int(v)
     if fobj is not None:
         params["objective"] = "none"
 

@@ -1,0 +1,214 @@
+"""DatasetLoader: text / binary file -> binned `Dataset`.
+
+Re-creates `src/io/dataset_loader.cpp`: `LoadFromFile` (`:162`) with header
+handling + label/weight/group column extraction (`SetHeader` `:25-140`),
+sidecar metadata files ``<data>.weight`` / ``<data>.query`` / ``<data>.init``
+(`src/io/metadata.cpp:376,400`), validation-set alignment against a
+reference dataset (`LoadFromFileAlignWithOtherDataset` `:224`), and the
+binary-file fast path (`LoadFromBinFile` `:268` -> `Dataset.save_binary`).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from .dataset import Dataset
+from .parser import create_parser, parse_dense
+
+
+def _parse_column_spec(spec: str, names: Optional[List[str]]) -> List[int]:
+    """``"0,1,2"`` or ``"name:a,b"`` -> column indices (feature space)."""
+    spec = str(spec).strip()
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        if not names:
+            raise ValueError(
+                f"column spec '{spec}' needs a file header with column names")
+        want = [s.strip() for s in spec[5:].split(",") if s.strip()]
+        out = []
+        for w in want:
+            if w not in names:
+                raise ValueError(f"column name '{w}' not found in header")
+            out.append(names.index(w))
+        return out
+    return [int(s) for s in spec.split(",") if s.strip()]
+
+
+def _read_sidecar(path: str) -> Optional[np.ndarray]:
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        vals = [float(x) for x in f.read().split()]
+    return np.asarray(vals, dtype=np.float64)
+
+
+class DatasetLoader:
+    """Host-side loader (reference `DatasetLoader`, `dataset_loader.h:24-86`)."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 predict_fun=None) -> None:
+        self.config = config or Config()
+        # prior-model predictor hook for continued training: raw scores of
+        # the loaded rows become init scores (reference
+        # `dataset_loader.h:66-67`, `application.cpp:90-93`)
+        self.predict_fun = predict_fun
+
+    # ------------------------------------------------------------------
+    def _read_text(self, filename: str) -> Tuple[Optional[List[str]],
+                                                 List[str]]:
+        if not os.path.isfile(filename):
+            raise FileNotFoundError(f"data file {filename} not found")
+        with open(filename, errors="replace") as f:
+            lines = f.read().splitlines()
+        lines = [ln for ln in lines if ln.strip()]
+        header = None
+        if self.config.header and lines:
+            header = lines[0]
+            lines = lines[1:]
+        return header, lines
+
+    def _resolve_label_idx(self, names: Optional[List[str]]) -> int:
+        spec = str(self.config.label_column).strip()
+        if not spec:
+            return 0
+        if spec.startswith("name:"):
+            if not names:
+                raise ValueError("label_column=name:... requires header=true")
+            w = spec[5:].strip()
+            if w not in names:
+                raise ValueError(f"label column '{w}' not found in header")
+            return names.index(w)
+        return int(spec)
+
+    # ------------------------------------------------------------------
+    def parse_file(self, filename: str
+                   ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """Parse a text data file.
+
+        Returns ``(labels [N], features [N, F], extras)`` where extras holds
+        feature_names, weights, groups, ignore column indices (feature
+        space, label removed — reference `SetHeader` semantics
+        `dataset_loader.cpp:62-140`).
+        """
+        cfg = self.config
+        header_line, lines = self._read_text(filename)
+        all_names = None
+        sep_guess = None
+        if header_line is not None:
+            for sep in ("\t", ",", " "):
+                if sep in header_line:
+                    sep_guess = sep
+                    break
+            all_names = ([s.strip() for s in header_line.split(sep_guess)]
+                         if sep_guess else [header_line.strip()])
+        label_idx = self._resolve_label_idx(all_names)
+        parser = create_parser(lines[:32], label_idx)
+        labels, feats = parse_dense(lines, parser)
+
+        feat_names = None
+        if all_names is not None:
+            feat_names = list(all_names)
+            if 0 <= label_idx < len(feat_names):
+                feat_names.pop(label_idx)
+
+        # weight / group columns (indices don't count the label column)
+        weights = None
+        groups_raw = None
+        ignore: set = set()
+        if str(cfg.weight_column).strip():
+            (widx,) = _parse_column_spec(cfg.weight_column, feat_names)
+            weights = feats[:, widx].copy()
+            ignore.add(widx)
+        if str(cfg.group_column).strip():
+            (gidx,) = _parse_column_spec(cfg.group_column, feat_names)
+            groups_raw = feats[:, gidx].copy()
+            ignore.add(gidx)
+        for c in _parse_column_spec(cfg.ignore_column, feat_names):
+            ignore.add(c)
+
+        # sidecar files override in-file columns (reference metadata.cpp)
+        side_w = _read_sidecar(filename + ".weight")
+        if side_w is not None:
+            weights = side_w
+        side_q = _read_sidecar(filename + ".query")
+        group_sizes = None
+        if side_q is not None:
+            group_sizes = side_q.astype(np.int64)
+        elif groups_raw is not None:
+            # in-file query ids -> boundary sizes (reference
+            # `Metadata::SetQueryId`): consecutive equal ids form one query
+            ids = groups_raw
+            change = np.flatnonzero(np.diff(ids) != 0)
+            bounds = np.concatenate([[0], change + 1, [len(ids)]])
+            group_sizes = np.diff(bounds).astype(np.int64)
+        init_score = _read_sidecar(filename + ".init")
+        if cfg.initscore_filename and os.path.isfile(cfg.initscore_filename):
+            init_score = _read_sidecar(cfg.initscore_filename)
+
+        extras = dict(feature_names=feat_names, weights=weights,
+                      group_sizes=group_sizes, init_score=init_score,
+                      ignore=sorted(ignore), label_idx=label_idx)
+        return labels, feats, extras
+
+    # ------------------------------------------------------------------
+    def _categorical_from_config(self, feat_names) -> Optional[List[int]]:
+        spec = str(self.config.categorical_feature).strip()
+        if not spec:
+            return None
+        return _parse_column_spec(spec, feat_names)
+
+    def load_from_file(self, filename: str, rank: int = 0,
+                       num_machines: int = 1) -> Dataset:
+        """reference `DatasetLoader::LoadFromFile` (`dataset_loader.cpp:162`).
+
+        With ``num_machines > 1`` and no pre-partition, rows are striped
+        round-robin across ranks (reference random / in-order partition,
+        `dataset_loader.cpp:606-650`)."""
+        cfg = self.config
+        if cfg.save_binary or filename.endswith(".bin"):
+            binpath = filename if filename.endswith(".bin") \
+                else filename + ".bin"
+            if os.path.isfile(binpath) and not cfg.save_binary:
+                return Dataset.load_binary(binpath)
+        labels, feats, ex = self.parse_file(filename)
+        if num_machines > 1 and not cfg.pre_partition:
+            sel = np.arange(len(labels)) % num_machines == rank
+            labels, feats = labels[sel], feats[sel]
+            for k in ("weights", "init_score"):
+                if ex[k] is not None:
+                    ex[k] = ex[k][sel]
+        for c in ex["ignore"]:
+            feats[:, c] = 0.0  # constant column -> trivial feature, never split
+        ds = Dataset.from_matrix(
+            feats, label=labels, config=cfg, weight=ex["weights"],
+            group=ex["group_sizes"],
+            init_score=ex["init_score"],
+            feature_names=ex["feature_names"],
+            categorical_feature=self._categorical_from_config(
+                ex["feature_names"]))
+        if self.predict_fun is not None and ds.metadata.init_score is None:
+            raw = np.asarray(self.predict_fun(feats), dtype=np.float64)
+            ds.metadata.set_init_score(raw.reshape(-1, order="F"))
+        if cfg.save_binary:
+            ds.save_binary(filename + ".bin")
+        return ds
+
+    def load_from_file_align_with_other_dataset(
+            self, filename: str, reference: Dataset) -> Dataset:
+        """Validation data binned with the training set's mappers
+        (reference `dataset_loader.cpp:224`)."""
+        labels, feats, ex = self.parse_file(filename)
+        for c in ex["ignore"]:
+            feats[:, c] = 0.0
+        ds = Dataset.from_matrix(
+            feats, label=labels, config=self.config, weight=ex["weights"],
+            group=ex["group_sizes"], init_score=ex["init_score"],
+            feature_names=ex["feature_names"], reference=reference)
+        if self.predict_fun is not None and ds.metadata.init_score is None:
+            raw = np.asarray(self.predict_fun(feats), dtype=np.float64)
+            ds.metadata.set_init_score(raw.reshape(-1, order="F"))
+        return ds

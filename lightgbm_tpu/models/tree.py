@@ -325,6 +325,13 @@ class Tree:
                 t.node_num_bin[:nl - 1] = geti("node_num_bin", nl - 1)
             else:
                 t.split_feature_inner[:nl - 1] = t.split_feature[:nl - 1]
+                # reference files carry the cat-bitset index in `threshold`
+                # (tree.cpp Tree::Tree(const char*)); mirror it into
+                # threshold_in_bin which the binned/_decision paths read
+                cat_nodes = (t.decision_type[:nl - 1]
+                             & K_CATEGORICAL_MASK) != 0
+                t.threshold_in_bin[:nl - 1][cat_nodes] = \
+                    t.threshold[:nl - 1][cat_nodes].astype(np.int32)
         t.leaf_value[:nl] = getf("leaf_value", nl)
         t.leaf_count[:nl] = geti("leaf_count", nl)
         if t.num_cat > 0:

@@ -78,6 +78,12 @@ def _threshold_l1(s, l1):
     return jnp.sign(s) * reg
 
 
+def threshold_l1_host(s: "np.ndarray", l1: float):
+    """NumPy twin of `_threshold_l1` for host-side paths (refit)."""
+    import numpy as np
+    return np.sign(s) * np.maximum(np.abs(s) - l1, 0.0)
+
+
 def _leaf_output(sg, sh, l1, l2, mds):
     """reference CalculateSplittedLeafOutput (feature_histogram.hpp:451)."""
     ret = -_threshold_l1(sg, l1) / (sh + l2)

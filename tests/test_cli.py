@@ -1,0 +1,216 @@
+"""CLI application, text parser/loader, refit, and if-else codegen tests
+(reference test strategy: tests/cpp_test CLI smoke + test_consistency.py
+examples-driven checks, SURVEY.md §4)."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application, parse_cli_args, read_config_file
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.io.parser import create_parser, detect_format, parse_dense
+
+REF_EXAMPLES = "/root/reference/examples"
+BINARY_DIR = os.path.join(REF_EXAMPLES, "binary_classification")
+HAS_REF = os.path.isdir(BINARY_DIR)
+
+
+# ---------------------------------------------------------------------------
+def test_detect_format():
+    assert detect_format(["1\t2\t3", "4\t5\t6"]) == "tsv"
+    assert detect_format(["1,2,3", "4,5,6"]) == "csv"
+    assert detect_format(["1 2:0.5 7:1.25", "0 1:2.0"]) == "libsvm"
+
+
+def test_parse_dense_tsv():
+    lines = ["1\t0.5\t2.5", "0\t1.5\t3.5"]
+    p = create_parser(lines, label_idx=0)
+    y, X = parse_dense(lines, p)
+    np.testing.assert_allclose(y, [1, 0])
+    np.testing.assert_allclose(X, [[0.5, 2.5], [1.5, 3.5]])
+
+
+def test_parse_dense_libsvm_absent_is_zero():
+    lines = ["1 0:0.5 2:1.5", "0 1:2.0"]
+    p = create_parser(lines, label_idx=0)
+    y, X = parse_dense(lines, p)
+    np.testing.assert_allclose(y, [1, 0])
+    np.testing.assert_allclose(X, [[0.5, 0.0, 1.5], [0.0, 2.0, 0.0]])
+
+
+def test_parse_dense_na_tokens():
+    lines = ["1,na,2.5", "0,1.5,NaN"]
+    p = create_parser(lines, label_idx=0)
+    y, X = parse_dense(lines, p)
+    assert np.isnan(X[0, 0]) and np.isnan(X[1, 1])
+
+
+def test_cli_args_and_config_file(tmp_path):
+    conf = tmp_path / "t.conf"
+    conf.write_text("num_trees = 7\nobjective = binary # comment\n")
+    params = parse_cli_args([f"config={conf}", "num_leaves=9"])
+    assert params["num_trees"] == "7"
+    assert params["objective"] == "binary"
+    assert params["num_leaves"] == "9"
+    assert read_config_file(str(conf))["objective"] == "binary"
+
+
+# ---------------------------------------------------------------------------
+def _make_text_dataset(tmp_path, n=400, f=5, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(int)
+    lines = "\n".join(
+        "\t".join([str(y[i])] + [f"{v:.6f}" for v in X[i]])
+        for i in range(n))
+    path = tmp_path / "train.tsv"
+    path.write_text(lines + "\n")
+    return str(path), X, y
+
+
+def test_loader_roundtrip(tmp_path):
+    path, X, y = _make_text_dataset(tmp_path)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = DatasetLoader(cfg).load_from_file(path)
+    assert ds.num_data == len(y)
+    assert ds.num_total_features == X.shape[1]
+    np.testing.assert_allclose(np.asarray(ds.metadata.label), y)
+
+
+def test_loader_weight_sidecar(tmp_path):
+    path, X, y = _make_text_dataset(tmp_path)
+    w = np.linspace(0.5, 1.5, len(y))
+    with open(path + ".weight", "w") as fh:
+        fh.write("\n".join(f"{v:.6f}" for v in w))
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = DatasetLoader(cfg).load_from_file(path)
+    np.testing.assert_allclose(np.asarray(ds.metadata.weight), w, atol=1e-5)
+
+
+def test_loader_query_sidecar(tmp_path):
+    path, X, y = _make_text_dataset(tmp_path, n=100)
+    with open(path + ".query", "w") as fh:
+        fh.write("40\n60\n")
+    cfg = Config.from_params({"objective": "lambdarank", "verbosity": -1})
+    ds = DatasetLoader(cfg).load_from_file(path)
+    np.testing.assert_array_equal(
+        np.asarray(ds.metadata.query_boundaries), [0, 40, 100])
+
+
+def test_loader_header_and_name_columns(tmp_path):
+    lines = ["target,a,b,c", "1,0.5,2.0,3.0", "0,1.5,0.5,1.0",
+             "1,0.1,0.2,0.3", "0,2.0,1.0,0.5"]
+    path = tmp_path / "h.csv"
+    path.write_text("\n".join(lines) + "\n")
+    cfg = Config.from_params({
+        "objective": "binary", "header": True,
+        "label_column": "name:target", "ignore_column": "name:c",
+        "verbosity": -1})
+    ds = DatasetLoader(cfg).load_from_file(str(path))
+    assert ds.feature_names == ["a", "b", "c"]
+    np.testing.assert_allclose(np.asarray(ds.metadata.label), [1, 0, 1, 0])
+    # ignored column c must never be a split candidate (trivial feature)
+    assert ds.used_feature_map[2] == -1
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(not HAS_REF, reason="reference examples not mounted")
+def test_cli_train_and_predict_reference_binary(tmp_path):
+    model = tmp_path / "model.txt"
+    out = tmp_path / "pred.txt"
+    app = Application([
+        f"config={BINARY_DIR}/train.conf",
+        f"data={BINARY_DIR}/binary.train",
+        f"valid_data={BINARY_DIR}/binary.test",
+        "num_trees=5", f"output_model={model}", "verbosity=-1",
+    ])
+    app.run()
+    assert model.is_file()
+    text = model.read_text()
+    assert text.startswith("tree") and "Tree=0" in text
+    papp = Application([
+        f"config={BINARY_DIR}/predict.conf",
+        f"data={BINARY_DIR}/binary.test",
+        f"input_model={model}", f"output_result={out}",
+    ])
+    papp.run()
+    preds = np.loadtxt(out)
+    labels = np.loadtxt(f"{BINARY_DIR}/binary.test", usecols=0)
+    assert preds.shape == labels.shape
+    assert 0.0 <= preds.min() and preds.max() <= 1.0
+    # better than chance after 5 trees (plain rank-sum AUC)
+    pos = preds[labels > 0]
+    neg = preds[labels <= 0]
+    auc = (pos[:, None] > neg[None, :]).mean()
+    assert auc > 0.7
+
+
+# ---------------------------------------------------------------------------
+def test_refit_changes_leaves_keeps_structure(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 4))
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        ds, num_boost_round=3, verbose_eval=False)
+    model_str = booster.model_to_string()
+    loaded = lgb.Booster(model_str=model_str)
+    before = [t.leaf_value[:t.num_leaves].copy() for t in loaded.trees]
+    struct = [t.split_feature[:t.num_leaves - 1].copy()
+              for t in loaded.trees]
+    y2 = 1.0 - y  # flipped labels: outputs must move
+    loaded.refit(X, y2, decay_rate=0.5)
+    after = [t.leaf_value[:t.num_leaves].copy() for t in loaded.trees]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    for t, s in zip(loaded.trees, struct):
+        np.testing.assert_array_equal(t.split_feature[:t.num_leaves - 1], s)
+
+
+def test_refit_decay_one_is_identity():
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((200, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "num_leaves": 5,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        ds, num_boost_round=2, verbose_eval=False)
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    before = [t.leaf_value[:t.num_leaves].copy() for t in loaded.trees]
+    loaded.refit(X, y, decay_rate=1.0)
+    for b, t in zip(before, loaded.trees):
+        np.testing.assert_allclose(b, t.leaf_value[:t.num_leaves])
+
+
+# ---------------------------------------------------------------------------
+def test_if_else_codegen_matches_predict(tmp_path):
+    from lightgbm_tpu.models.model_text import model_to_if_else
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((300, 5))
+    X[::11, 1] = np.nan  # exercise missing handling in codegen
+    y = (np.nan_to_num(X[:, 0] + X[:, 1]) > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        ds, num_boost_round=3, verbose_eval=False)
+    code = model_to_if_else(booster.trees, 1)
+    src = tmp_path / "pred.cpp"
+    src.write_text(code)
+    so = tmp_path / "pred.so"
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    lib = ctypes.CDLL(str(so))
+    lib.PredictRaw.restype = ctypes.c_double
+    lib.PredictRaw.argtypes = [ctypes.POINTER(ctypes.c_double),
+                               ctypes.c_int]
+    py = booster.predict(X[:50], raw_score=True)
+    rows = np.ascontiguousarray(X[:50], dtype=np.float64)
+    cc = np.array([lib.PredictRaw(
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 0)
+        for r in rows])
+    np.testing.assert_allclose(py, cc, atol=1e-12)
