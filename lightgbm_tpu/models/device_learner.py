@@ -108,13 +108,37 @@ class DeviceTreeLearner:
     """
 
     def __init__(self, cfg: Config, dataset: Dataset,
-                 axis_name: Optional[str] = None) -> None:
+                 axis_name: Optional[str] = None,
+                 parallel_mode: Optional[str] = None,
+                 feature_pad_to: Optional[int] = None,
+                 mesh_size: int = 1) -> None:
         self.cfg = cfg
         self.axis_name = axis_name
+        # serial (single program) / data (rows sharded, psum histograms) /
+        # feature (rows replicated, feature-block histogram work division) /
+        # voting (rows sharded, top-k vote + selected-feature reduce)
+        self.parallel_mode = parallel_mode or (
+            "data" if axis_name is not None else "serial")
+        self.mesh_size = mesh_size
         self.ds = dataset
         self.n = dataset.num_data
-        self.num_features = dataset.num_features
+        self.num_real_features = dataset.num_features
         meta = dataset.feature_meta_arrays()
+        if feature_pad_to and feature_pad_to > len(meta["num_bin"]):
+            # pad the feature axis so it divides evenly over the mesh
+            # (feature-parallel block slicing); padded features are trivial
+            # (num_bin=2, no data) and masked out of every split search
+            pad = feature_pad_to - len(meta["num_bin"])
+            meta = dict(meta)
+            meta["num_bin"] = np.concatenate(
+                [meta["num_bin"], np.full(pad, 2, meta["num_bin"].dtype)])
+            for key, fill in (("default_bin", 0), ("missing_type", 0),
+                              ("bin_type", 0), ("monotone", 0)):
+                meta[key] = np.concatenate(
+                    [meta[key], np.full(pad, fill, meta[key].dtype)])
+            meta["penalty"] = np.concatenate(
+                [meta["penalty"], np.ones(pad, meta["penalty"].dtype)])
+        self.num_features = len(meta["num_bin"])
         self.meta = meta
         self.max_bin_global = int(meta["num_bin"].max()) \
             if len(meta["num_bin"]) else 2
@@ -152,10 +176,14 @@ class DeviceTreeLearner:
     def feature_mask(self) -> Optional[np.ndarray]:
         frac = self.cfg.feature_fraction
         if frac >= 1.0:
+            if self.num_features != self.num_real_features:
+                mask = np.zeros(self.num_features, bool)
+                mask[:self.num_real_features] = True  # padded features off
+                return mask
             return None
-        used_cnt = max(1, int(round(self.num_features * frac)))
+        used_cnt = max(1, int(round(self.num_real_features * frac)))
         mask = np.zeros(self.num_features, bool)
-        mask[self._feat_rng.choice(self.num_features, used_cnt,
+        mask[self._feat_rng.choice(self.num_real_features, used_cnt,
                                    replace=False)] = True
         return mask
 
@@ -193,13 +221,44 @@ class DeviceTreeLearner:
         precision = self.hist_precision
         depth_limit = self._depth_limit
 
+        mode = self.parallel_mode
+        nd = self.mesh_size if mode == "feature" else 1
+        f_block = F // nd if mode == "feature" else F
+        if mode == "voting":
+            vote_k = max(1, min(int(cfg.top_k), F))
+            vote_sel = min(2 * vote_k, F)
+            # local searches relax min_data/min_hessian by the machine count
+            # (reference voting_parallel_tree_learner.cpp:58-59)
+            m = max(1, self.mesh_size)
+            hyper_local = self.hyper._replace(
+                min_data_in_leaf=max(1, self.hyper.min_data_in_leaf // m),
+                min_sum_hessian_in_leaf=(
+                    self.hyper.min_sum_hessian_in_leaf / m))
+            finder_local = make_split_finder(hyper_local, self.meta, B)
+
         def hist_bucket(size):
             def fn(bins, indices, grad, hess, begin, count):
                 idx = lax.dynamic_slice(indices, (begin,), (size,))
                 pos = jnp.arange(size, dtype=jnp.int32)
                 valid = pos < count
                 safe = jnp.where(valid, idx, 0)
-                return histogram_from_gathered(bins[safe], grad[safe],
+                rows = bins[safe]
+                if mode == "feature":
+                    # feature-parallel: each shard histograms only its
+                    # feature block (reference feature_parallel_tree_
+                    # learner.cpp:33-52 work division); the psum that
+                    # follows assembles the global histogram, subsuming
+                    # SyncUpGlobalBestSplit
+                    start = lax.axis_index(self.axis_name) * f_block
+                    rows = lax.dynamic_slice(
+                        rows, (jnp.int32(0), start), (size, f_block))
+                    hb = histogram_from_gathered(rows, grad[safe],
+                                                 hess[safe], valid, B,
+                                                 chunk, precision)
+                    full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
+                    return lax.dynamic_update_slice(
+                        full, hb, (start, jnp.int32(0), jnp.int32(0)))
+                return histogram_from_gathered(rows, grad[safe],
                                                hess[safe], valid, B, chunk,
                                                precision)
             return fn
@@ -216,10 +275,24 @@ class DeviceTreeLearner:
         part_fns = [part_bucket(s) for s in buckets]
         axis = self.axis_name
 
-        def _gsum(x):
-            """Cross-shard sum — identity in serial mode. MUST be called at
-            uniform program points (never inside a lax.switch branch)."""
-            return lax.psum(x, axis) if axis is not None else x
+        # Collective placement by mode (all ride ICI as XLA all-reduces;
+        # they sit at uniform program points so shards never diverge):
+        #   data:    histograms psum'd (ReduceScatter analogue); row-local
+        #            scalars psum'd (root-sums allreduce)
+        #   feature: block histograms psum'd into the global histogram
+        #            (subsumes SyncUpGlobalBestSplit); rows replicated so
+        #            scalars are already global
+        #   voting:  histograms stay LOCAL (only elected features are
+        #            reduced, inside eval_leaf); row-local scalars psum'd
+        def _gsum_hist(x):
+            if axis is not None and mode in ("data", "feature"):
+                return lax.psum(x, axis)
+            return x
+
+        def _gsum_scalar(x):
+            if axis is not None and mode in ("data", "voting"):
+                return lax.psum(x, axis)
+            return x
 
         def build(bins, indices, grad, hess, root_count, feature_mask_f32):
             # ---------- state ----------
@@ -267,23 +340,19 @@ class DeviceTreeLearner:
             root_hist = lax.switch(
                 bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
                 root_count)
-            root_hist = _gsum(root_hist)
+            root_hist = _gsum_hist(root_hist)
             hist_store = hist_store.at[0].set(root_hist)
             # root grad/hess sums by direct reduction (data-parallel: the
             # root-sums allreduce, data_parallel_tree_learner.cpp:120-145)
             root_g, root_h = _masked_sums(indices, grad, hess, root_count,
                                           root_padded)
-            root_g, root_h = _gsum(root_g), _gsum(root_h)
-            root_count_g = _gsum(root_count)
+            root_g, root_h = _gsum_scalar(root_g), _gsum_scalar(root_h)
+            root_count_g = _gsum_scalar(root_count)
             leaf_count_glob = jnp.zeros(L, jnp.int32).at[0].set(root_count_g)
             leaf_sum_g = jnp.zeros(L, jnp.float32).at[0].set(root_g)
             leaf_sum_h = jnp.zeros(L, jnp.float32).at[0].set(root_h)
 
-            def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
-                out = finder(hist, sg, sh, cnt, minc, maxc)
-                gain = jnp.where(feature_mask_f32 > 0, out["gain"], NEG_INF)
-                gain = jnp.where(depth >= depth_limit,
-                                 jnp.full_like(gain, NEG_INF), gain)
+            def _payload(out, gain):
                 f = jnp.argmax(gain)
                 return {
                     "gain": gain[f],
@@ -301,6 +370,43 @@ class DeviceTreeLearner:
                     "left_output": out["left_output"][f],
                     "right_output": out["right_output"][f],
                 }
+
+            def _mask_gain(gain, depth):
+                gain = jnp.where(feature_mask_f32 > 0, gain, NEG_INF)
+                return jnp.where(depth >= depth_limit,
+                                 jnp.full_like(gain, NEG_INF), gain)
+
+            if mode == "voting":
+                # PV-Tree (reference voting_parallel_tree_learner.cpp:
+                # 262-400): local top-k vote -> global vote -> reduce only
+                # the elected features' histograms -> global best split.
+                # `hist` here is this shard's LOCAL histogram of the leaf.
+                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                    # local leaf sums: every row lands in exactly one bin of
+                    # feature 0, so its histogram column sums to the local
+                    # totals (no FixHistogram-style bin skipping here)
+                    lsg = jnp.sum(hist[0, :, 0])
+                    lsh = jnp.sum(hist[0, :, 1])
+                    lcnt = jnp.sum(hist[0, :, 2]).astype(jnp.int32)
+                    lout = finder_local(hist, lsg, lsh, lcnt, minc, maxc)
+                    lgain = _mask_gain(lout["gain"], depth)
+                    _, top_idx = lax.top_k(lgain, vote_k)
+                    # votes weighted by local data share (GlobalVoting
+                    # weighting, voting_parallel_tree_learner.cpp:170-200)
+                    votes = jnp.zeros((F,), jnp.float32).at[top_idx].add(
+                        1.0 + lcnt.astype(jnp.float32))
+                    votes = lax.psum(votes, axis)
+                    _, sel_idx = lax.top_k(votes, vote_sel)  # same on all
+                    hist_sel = lax.psum(hist[sel_idx], axis)
+                    ghist = jnp.zeros_like(hist).at[sel_idx].set(hist_sel)
+                    out = finder(ghist, sg, sh, cnt, minc, maxc)
+                    selmask = jnp.zeros((F,), bool).at[sel_idx].set(True)
+                    gain = jnp.where(selmask, out["gain"], NEG_INF)
+                    return _payload(out, _mask_gain(gain, depth))
+            else:
+                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                    out = finder(hist, sg, sh, cnt, minc, maxc)
+                    return _payload(out, _mask_gain(out["gain"], depth))
 
             root_best = eval_leaf(root_hist, root_g, root_h, root_count_g,
                                   jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
@@ -422,7 +528,7 @@ class DeviceTreeLearner:
                     bk2 = self._bucket_index(sm_count, nbk)
                     sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
                                          grad, hess, sm_begin, sm_count)
-                    sm_hist = _gsum(sm_hist)
+                    sm_hist = _gsum_hist(sm_hist)
                     lg_hist = hist_store[bl] - sm_hist
                     left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
                     right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)

@@ -122,11 +122,15 @@ class GBDT:
         if self.use_fused:
             if cfg.tree_learner == "serial" or len(jax.devices()) == 1:
                 self.learner = DeviceTreeLearner(cfg, train_data)
+            elif cfg.tree_learner == "feature":
+                from ..parallel.feature_parallel import \
+                    FeatureParallelTreeLearner
+                self.learner = FeatureParallelTreeLearner(cfg, train_data)
+            elif cfg.tree_learner == "voting":
+                from ..parallel.voting_parallel import \
+                    VotingParallelTreeLearner
+                self.learner = VotingParallelTreeLearner(cfg, train_data)
             else:
-                # rows sharded over the device mesh; feature/voting variants
-                # currently run the data-parallel strategy (same results,
-                # different comms pattern) until their dedicated sharding
-                # lands
                 from ..parallel.data_parallel import DataParallelTreeLearner
                 self.learner = DataParallelTreeLearner(cfg, train_data)
             self._trav_nb = jnp.asarray(self.learner.meta["num_bin"],

@@ -47,6 +47,8 @@ class DataParallelTreeLearner:
     (the reference crosses {serial,data,...}x{cpu,gpu} the same way,
     tree_learner.cpp:13-36)."""
 
+    mode = "data"
+
     def __init__(self, cfg: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None) -> None:
         self.axis_name = "data"
@@ -54,7 +56,9 @@ class DataParallelTreeLearner:
             cfg.num_machines if cfg.num_machines > 1 else None,
             self.axis_name)
         self.nd = int(self.mesh.devices.size)
-        self.inner = DeviceTreeLearner(cfg, dataset, axis_name=self.axis_name)
+        self.inner = DeviceTreeLearner(cfg, dataset, axis_name=self.axis_name,
+                                       parallel_mode=self.mode,
+                                       mesh_size=self.nd)
         self.cfg = cfg
         self.ds = dataset
         n = dataset.num_data

@@ -1,0 +1,27 @@
+"""Voting-parallel (PV-Tree) learning over a `jax.sharding.Mesh`.
+
+TPU-native re-design of the reference `VotingParallelTreeLearner`
+(`src/treelearner/voting_parallel_tree_learner.cpp`): rows are sharded like
+the data-parallel learner, but instead of reducing FULL histograms across
+shards, each shard runs a relaxed LOCAL split search on its own histograms,
+votes its top-k features (`top_k` config), the votes are globally summed
+(`GlobalVoting` `:170-200`), and only the elected ~2k features' histograms
+are cross-shard reduced before the global best-split search
+(`FindBestSplits` `:262-400`) — cutting the per-split collective volume from
+O(F*B) to O(top_k*B).
+
+All of that runs inside the same fused whole-tree program: see the
+``mode == "voting"`` eval path in
+`lightgbm_tpu/models/device_learner.py` (`_make_build_fn`); this wrapper
+only selects the mode — the row sharding, score updates, and partition
+bookkeeping are identical to the data-parallel learner.
+"""
+from __future__ import annotations
+
+from .data_parallel import DataParallelTreeLearner
+
+
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    """Rows-sharded learner with top-k feature voting collectives."""
+
+    mode = "voting"
