@@ -4,11 +4,26 @@ Why: the host-driven `SerialTreeLearner` issues ~15 host<->device syncs per
 split; on a tunneled TPU each sync costs ~100ms, dwarfing compute. This
 learner keeps the entire leaf-wise loop (reference
 `SerialTreeLearner::Train`, serial_tree_learner.cpp:173-237) inside one
-`lax.fori_loop`: per-leaf state, the histogram pool
+`lax.while_loop`: per-leaf state, the histogram pool
 (reference HistogramPool, feature_histogram.hpp:654), the partition, and the
 recorded splits all live in device arrays. Dynamic leaf sizes are handled by
 a `lax.switch` over power-of-two size buckets — each branch compiles its own
 statically-shaped gather + MXU histogram / stable partition.
+
+TPU-profile-driven layout choices (v5e measurements):
+- random row gathers are the dominant cost (~10-16 ns/element through XLA's
+  gather lowering), so the ROOT histogram reads the binned matrix
+  contiguously whenever the partition is the identity (fresh per-tree
+  partitions make that the common case), and per-split work is bucketed to
+  the smaller child's power-of-two size;
+- a TRANSPOSED copy of the bins (`bins_T[F, N]`) makes the split feature's
+  column a contiguous `dynamic_slice` instead of a stride-F gather that cost
+  ~300us/split;
+- the per-leaf best-split/record state lives in a few PACKED [L, 8]-wide
+  arrays rather than ~26 scalar arrays — each split updates 6 rows, not 40,
+  which keeps the sequential tiny-op chain per split short;
+- `lax.while_loop` (not fori_loop+cond) stops the program at the last real
+  split, so early-stopped trees don't pay for the remaining leaf budget.
 
 The host pulls nothing during training; a finished tree is a `TreeRecord`
 pytree of device arrays, convertible to a host `Tree` (one batched transfer)
@@ -29,12 +44,32 @@ from jax import lax
 from ..config import Config
 from ..io.dataset import Dataset
 from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered
-from ..ops.partition import (categorical_goes_left, numerical_goes_left,
-                             split_partition)
+from ..ops.partition import (categorical_goes_left, leaf_value_fill,
+                             numerical_goes_left, split_partition,
+                             unpermute_to_rows)
 from ..ops.split import SplitHyper, make_split_finder
 from .tree import Tree
 
 NEG_INF = -jnp.inf
+
+# packed per-leaf "best split" float lanes
+BF_GAIN, BF_LG, BF_LH, BF_RG, BF_RH, BF_LOUT, BF_ROUT = range(7)
+BF_W = 8
+# packed per-leaf "best split" int lanes
+BI_FEAT, BI_THR, BI_LC, BI_RC, BI_DEFLEFT, BI_ISCAT = range(6)
+BI_W = 8
+# packed per-leaf float state lanes
+LF_SG, LF_SH, LF_MINC, LF_MAXC, LF_VALUE = range(5)
+LF_W = 8
+# packed per-leaf int state lanes
+LI_BEGIN, LI_COUNT, LI_COUNTG, LI_DEPTH = range(4)
+LI_W = 8
+# packed per-split record float lanes
+RF_LOUT, RF_ROUT, RF_GAIN, RF_IVAL = range(4)
+RF_W = 4
+# packed per-split record int lanes
+RI_LEAF, RI_FEAT, RI_THR, RI_DEFLEFT, RI_ISCAT, RI_LC, RI_RC = range(7)
+RI_W = 8
 
 
 class TreeRecord(NamedTuple):
@@ -55,7 +90,7 @@ class TreeRecord(NamedTuple):
     leaf_value: jax.Array          # f32[L] final leaf outputs
     leaf_count_arr: jax.Array      # i32[L]
     leaf_begin: jax.Array          # i32[L] partition begins
-    leaf_cnt_part: jax.Array       # i32[L] partition counts
+    leaf_cnt_part: jax.Array       # i32[L]
 
 
 def _pow2ceil(n: int) -> int:
@@ -103,8 +138,9 @@ class DeviceTreeLearner:
     needed), and leaf counts split into a LOCAL set driving the per-shard
     partition and a GLOBAL set driving split decisions (the reference's
     `global_data_count_in_leaf_`, data_parallel_tree_learner.cpp:251-257).
-    Collectives sit at uniform program points (outside `lax.switch`
-    branches) so shards never diverge on collective schedules.
+    Collectives sit inside the while-loop body, which is safe because every
+    shard makes identical split decisions from the identical (global)
+    histograms and therefore iterates the loop the same number of times.
     """
 
     def __init__(self, cfg: Config, dataset: Dataset,
@@ -144,6 +180,7 @@ class DeviceTreeLearner:
             if len(meta["num_bin"]) else 2
         self._bins_dev = None  # lazy: the data-parallel wrapper never
         # materializes this second (replicated) device copy of the bins
+        self._bins_T_dev = None
         self.hyper = SplitHyper.from_config(cfg)
         self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
         self.mappers = dataset.used_mappers()
@@ -156,7 +193,7 @@ class DeviceTreeLearner:
         self._db_dev = jnp.asarray(meta["default_bin"], jnp.int32)
         self._mt_dev = jnp.asarray(meta["missing_type"], jnp.int32)
         self._mono_any = bool(np.any(meta["monotone"] != 0))
-        self._build_cache: Dict[int, callable] = {}
+        self._build_cache: Dict[Tuple[int, bool], callable] = {}
         self._depth_limit = cfg.max_depth if cfg.max_depth > 0 else 1 << 30
 
     @property
@@ -165,12 +202,37 @@ class DeviceTreeLearner:
             self._bins_dev = jnp.asarray(self.ds.bins)
         return self._bins_dev
 
+    @property
+    def bins_T_dev(self) -> jax.Array:
+        """Transposed bins [F, N] so a dynamic feature's column is one
+        contiguous dynamic_slice (the row-major column read costs a stride-F
+        pass over the whole matrix on TPU)."""
+        if self._bins_T_dev is None:
+            self._bins_T_dev = jnp.asarray(
+                np.ascontiguousarray(self.ds.bins.T))
+        return self._bins_T_dev
+
     def add_score(self, score_row: jax.Array, trav: Dict,
                   scale: float) -> jax.Array:
         """score += scale * tree(x) over the training bins."""
         return add_record_score(score_row, self.bins_dev, trav, self._nb_dev,
                                 self._db_dev, self._mt_dev,
                                 jnp.float32(scale))
+
+    def add_score_from_partition(self, score_row: jax.Array,
+                                 record: "TreeRecord", indices: jax.Array,
+                                 root_count, scale: float) -> jax.Array:
+        """score += scale * tree(x) using the final partition: each leaf's
+        rows are contiguous in `indices`, so the per-row leaf value is a
+        scatter-at-L-boundaries + cumsum fill, and the only irregular step is
+        ONE key-sort back to row order — no per-level tree traversal.
+        (Replaces the reference's Tree::AddPredictionToScore bulk update,
+        tree.cpp:112-204.)"""
+        fill = leaf_value_fill(record.leaf_begin, record.leaf_cnt_part,
+                               record.leaf_value, indices.shape[0])
+        delta = unpermute_to_rows(indices, fill, root_count,
+                                  score_row.shape[0])
+        return score_row + jnp.float32(scale) * delta
 
     # ------------------------------------------------------------------
     def feature_mask(self) -> Optional[np.ndarray]:
@@ -207,10 +269,17 @@ class DeviceTreeLearner:
         return jnp.clip(b, 0, n_buckets - 1)
 
     # ------------------------------------------------------------------
-    def _make_build_fn(self, root_padded: int):
-        """Build the jitted whole-tree program for a given root size."""
+    def _make_build_fn(self, root_padded: int, root_contiguous: bool):
+        """Build the jitted whole-tree program for a given root size.
+
+        root_contiguous: the root partition is the identity permutation
+        (fresh per-tree partition, no bagging), so the root histogram and
+        root sums read bins/grad/hess contiguously — skipping the single
+        biggest random gather of the tree.
+        """
         cfg = self.cfg
         L = cfg.num_leaves
+        Lm1 = max(L - 1, 1)
         F = self.num_features
         B = self.max_bin_global
         buckets = self._buckets_for(root_padded)
@@ -220,6 +289,7 @@ class DeviceTreeLearner:
         chunk = int(cfg.tpu_hist_chunk)
         precision = self.hist_precision
         depth_limit = self._depth_limit
+        mono_dev = jnp.asarray(self.meta["monotone"], jnp.int32)
 
         mode = self.parallel_mode
         nd = self.mesh_size if mode == "feature" else 1
@@ -236,6 +306,24 @@ class DeviceTreeLearner:
                     self.hyper.min_sum_hessian_in_leaf / m))
             finder_local = make_split_finder(hyper_local, self.meta, B)
 
+        def _feature_block_hist(rows, g, h, valid):
+            if mode != "feature":
+                return histogram_from_gathered(rows, g, h, valid, B, chunk,
+                                               precision)
+            # feature-parallel: each shard histograms only its feature block
+            # (reference feature_parallel_tree_learner.cpp:33-52 work
+            # division); the psum that follows assembles the global
+            # histogram, subsuming SyncUpGlobalBestSplit
+            start = lax.axis_index(self.axis_name) * f_block
+            size = rows.shape[0]
+            rows = lax.dynamic_slice(rows, (jnp.int32(0), start),
+                                     (size, f_block))
+            hb = histogram_from_gathered(rows, g, h, valid, B, chunk,
+                                         precision)
+            full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
+            return lax.dynamic_update_slice(
+                full, hb, (start, jnp.int32(0), jnp.int32(0)))
+
         def hist_bucket(size):
             def fn(bins, indices, grad, hess, begin, count):
                 idx = lax.dynamic_slice(indices, (begin,), (size,))
@@ -243,24 +331,8 @@ class DeviceTreeLearner:
                 valid = pos < count
                 safe = jnp.where(valid, idx, 0)
                 rows = bins[safe]
-                if mode == "feature":
-                    # feature-parallel: each shard histograms only its
-                    # feature block (reference feature_parallel_tree_
-                    # learner.cpp:33-52 work division); the psum that
-                    # follows assembles the global histogram, subsuming
-                    # SyncUpGlobalBestSplit
-                    start = lax.axis_index(self.axis_name) * f_block
-                    rows = lax.dynamic_slice(
-                        rows, (jnp.int32(0), start), (size, f_block))
-                    hb = histogram_from_gathered(rows, grad[safe],
-                                                 hess[safe], valid, B,
-                                                 chunk, precision)
-                    full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
-                    return lax.dynamic_update_slice(
-                        full, hb, (start, jnp.int32(0), jnp.int32(0)))
-                return histogram_from_gathered(rows, grad[safe],
-                                               hess[safe], valid, B, chunk,
-                                               precision)
+                return _feature_block_hist(rows, grad[safe], hess[safe],
+                                           valid)
             return fn
 
         def part_bucket(size):
@@ -276,7 +348,8 @@ class DeviceTreeLearner:
         axis = self.axis_name
 
         # Collective placement by mode (all ride ICI as XLA all-reduces;
-        # they sit at uniform program points so shards never diverge):
+        # every shard takes identical split decisions so the collective
+        # schedules never diverge):
         #   data:    histograms psum'd (ReduceScatter analogue); row-local
         #            scalars psum'd (root-sums allreduce)
         #   feature: block histograms psum'd into the global histogram
@@ -294,87 +367,39 @@ class DeviceTreeLearner:
                 return lax.psum(x, axis)
             return x
 
-        def build(bins, indices, grad, hess, root_count, feature_mask_f32):
-            # ---------- state ----------
-            leaf_begin = jnp.zeros(L, jnp.int32)
-            leaf_count = jnp.zeros(L, jnp.int32).at[0].set(root_count)
-            leaf_depth = jnp.zeros(L, jnp.int32)
-            leaf_minc = jnp.full(L, -jnp.inf, jnp.float32)
-            leaf_maxc = jnp.full(L, jnp.inf, jnp.float32)
-            hist_store = jnp.zeros((L, F, B, NUM_HIST_STATS), jnp.float32)
+        # loop budget: num_leaves-1 splits (0 when num_leaves == 1); Lm1 is
+        # only the (>=1) record-array length
+        split_budget = max(L - 1, 0)
 
-            best = {
-                "gain": jnp.full(L, NEG_INF, jnp.float32),
-                "feature": jnp.zeros(L, jnp.int32),
-                "threshold": jnp.zeros(L, jnp.int32),
-                "default_left": jnp.zeros(L, bool),
-                "is_cat": jnp.zeros(L, bool),
-                "cat_bitset": jnp.zeros((L, 8), jnp.uint32),
-                "left_g": jnp.zeros(L, jnp.float32),
-                "left_h": jnp.zeros(L, jnp.float32),
-                "left_c": jnp.zeros(L, jnp.int32),
-                "right_g": jnp.zeros(L, jnp.float32),
-                "right_h": jnp.zeros(L, jnp.float32),
-                "right_c": jnp.zeros(L, jnp.int32),
-                "left_output": jnp.zeros(L, jnp.float32),
-                "right_output": jnp.zeros(L, jnp.float32),
-            }
-            rec = {
-                "leaf": jnp.zeros(max(L - 1, 1), jnp.int32),
-                "feature": jnp.zeros(max(L - 1, 1), jnp.int32),
-                "threshold_bin": jnp.zeros(max(L - 1, 1), jnp.int32),
-                "default_left": jnp.zeros(max(L - 1, 1), bool),
-                "is_cat": jnp.zeros(max(L - 1, 1), bool),
-                "cat_bitset": jnp.zeros((max(L - 1, 1), 8), jnp.uint32),
-                "left_output": jnp.zeros(max(L - 1, 1), jnp.float32),
-                "right_output": jnp.zeros(max(L - 1, 1), jnp.float32),
-                "left_count": jnp.zeros(max(L - 1, 1), jnp.int32),
-                "right_count": jnp.zeros(max(L - 1, 1), jnp.int32),
-                "gain": jnp.zeros(max(L - 1, 1), jnp.float32),
-                "internal_value": jnp.zeros(max(L - 1, 1), jnp.float32),
-            }
-            leaf_value = jnp.zeros(L, jnp.float32)
-
-            # ---------- root ----------
-            bsel = self._bucket_index(root_count, nbk)
-            root_hist = lax.switch(
-                bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
-                root_count)
-            root_hist = _gsum_hist(root_hist)
-            hist_store = hist_store.at[0].set(root_hist)
-            # root grad/hess sums by direct reduction (data-parallel: the
-            # root-sums allreduce, data_parallel_tree_learner.cpp:120-145)
-            root_g, root_h = _masked_sums(indices, grad, hess, root_count,
-                                          root_padded)
-            root_g, root_h = _gsum_scalar(root_g), _gsum_scalar(root_h)
-            root_count_g = _gsum_scalar(root_count)
-            leaf_count_glob = jnp.zeros(L, jnp.int32).at[0].set(root_count_g)
-            leaf_sum_g = jnp.zeros(L, jnp.float32).at[0].set(root_g)
-            leaf_sum_h = jnp.zeros(L, jnp.float32).at[0].set(root_h)
-
-            def _payload(out, gain):
-                f = jnp.argmax(gain)
-                return {
-                    "gain": gain[f],
-                    "feature": f.astype(jnp.int32),
-                    "threshold": out["threshold"][f],
-                    "default_left": out["default_left"][f],
-                    "is_cat": out["is_cat"][f],
-                    "cat_bitset": out["cat_bitset"][f],
-                    "left_g": out["left_g"][f],
-                    "left_h": out["left_h"][f],
-                    "left_c": out["left_c"][f],
-                    "right_g": out["right_g"][f],
-                    "right_h": out["right_h"][f],
-                    "right_c": out["right_c"][f],
-                    "left_output": out["left_output"][f],
-                    "right_output": out["right_output"][f],
-                }
+        def build(bins, bins_T, indices, grad, hess, root_count,
+                  feature_mask_f32):
 
             def _mask_gain(gain, depth):
                 gain = jnp.where(feature_mask_f32 > 0, gain, NEG_INF)
                 return jnp.where(depth >= depth_limit,
                                  jnp.full_like(gain, NEG_INF), gain)
+
+            def _payload(out, gain):
+                """Pack the winning feature's split into (vecF, vecI, bits)."""
+                f = jnp.argmax(gain)
+                vecF = jnp.zeros(BF_W, jnp.float32)
+                vecF = vecF.at[BF_GAIN].set(gain[f])
+                vecF = vecF.at[BF_LG].set(out["left_g"][f])
+                vecF = vecF.at[BF_LH].set(out["left_h"][f])
+                vecF = vecF.at[BF_RG].set(out["right_g"][f])
+                vecF = vecF.at[BF_RH].set(out["right_h"][f])
+                vecF = vecF.at[BF_LOUT].set(out["left_output"][f])
+                vecF = vecF.at[BF_ROUT].set(out["right_output"][f])
+                vecI = jnp.zeros(BI_W, jnp.int32)
+                vecI = vecI.at[BI_FEAT].set(f.astype(jnp.int32))
+                vecI = vecI.at[BI_THR].set(out["threshold"][f])
+                vecI = vecI.at[BI_LC].set(out["left_c"][f])
+                vecI = vecI.at[BI_RC].set(out["right_c"][f])
+                vecI = vecI.at[BI_DEFLEFT].set(
+                    out["default_left"][f].astype(jnp.int32))
+                vecI = vecI.at[BI_ISCAT].set(
+                    out["is_cat"][f].astype(jnp.int32))
+                return vecF, vecI, out["cat_bitset"][f]
 
             if mode == "voting":
                 # PV-Tree (reference voting_parallel_tree_learner.cpp:
@@ -408,172 +433,204 @@ class DeviceTreeLearner:
                     out = finder(hist, sg, sh, cnt, minc, maxc)
                     return _payload(out, _mask_gain(out["gain"], depth))
 
-            root_best = eval_leaf(root_hist, root_g, root_h, root_count_g,
-                                  jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
-                                  jnp.int32(0))
-            best = {k: best[k].at[0].set(root_best[k]) for k in best}
+            # ---------- root ----------
+            if root_contiguous:
+                # identity partition: read the head of bins/grad/hess
+                # directly (static slice, no gather); pow2 padding can
+                # exceed the physical row count, so clamp statically
+                rp = min(root_padded, bins.shape[0], grad.shape[0])
+                pos = jnp.arange(rp, dtype=jnp.int32)
+                valid = pos < root_count
+                rows = lax.slice(bins, (0, 0), (rp, F))
+                g0 = lax.slice(grad, (0,), (rp,))
+                h0 = lax.slice(hess, (0,), (rp,))
+                root_hist = _feature_block_hist(rows, g0, h0, valid)
+                root_g = jnp.sum(jnp.where(valid, g0, 0.0))
+                root_h = jnp.sum(jnp.where(valid, h0, 0.0))
+            else:
+                bsel = self._bucket_index(root_count, nbk)
+                root_hist = lax.switch(
+                    bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
+                    root_count)
+                root_g, root_h = _masked_sums(indices, grad, hess, root_count,
+                                              root_padded)
+            root_hist = _gsum_hist(root_hist)
+            # root grad/hess sums (data-parallel: the root-sums allreduce,
+            # data_parallel_tree_learner.cpp:120-145)
+            root_g, root_h = _gsum_scalar(root_g), _gsum_scalar(root_h)
+            root_count_g = _gsum_scalar(root_count)
 
-            state = (indices, leaf_begin, leaf_count, leaf_count_glob,
-                     leaf_sum_g, leaf_sum_h,
-                     leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
-                     leaf_value, jnp.int32(0), jnp.asarray(False))
+            # ---------- packed state ----------
+            hist_store = jnp.zeros((L, F, B, NUM_HIST_STATS), jnp.float32)
+            hist_store = hist_store.at[0].set(root_hist)
+            leafF = jnp.zeros((L, LF_W), jnp.float32)
+            leafF = leafF.at[:, LF_MINC].set(-jnp.inf)
+            leafF = leafF.at[:, LF_MAXC].set(jnp.inf)
+            leafF = leafF.at[0, LF_SG].set(root_g)
+            leafF = leafF.at[0, LF_SH].set(root_h)
+            leafI = jnp.zeros((L, LI_W), jnp.int32)
+            leafI = leafI.at[0, LI_COUNT].set(root_count)
+            leafI = leafI.at[0, LI_COUNTG].set(root_count_g)
+            bestF = jnp.full((L, BF_W), NEG_INF, jnp.float32)
+            bestI = jnp.zeros((L, BI_W), jnp.int32)
+            bestB = jnp.zeros((L, 8), jnp.uint32)
+            recF = jnp.zeros((Lm1, RF_W), jnp.float32)
+            recI = jnp.zeros((Lm1, RI_W), jnp.int32)
+            recB = jnp.zeros((Lm1, 8), jnp.uint32)
 
-            def body(s, state):
-                (indices, leaf_begin, leaf_count, leaf_count_glob,
-                 leaf_sum_g, leaf_sum_h,
-                 leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
-                 leaf_value, n_splits, done) = state
-                bl = jnp.argmax(best["gain"]).astype(jnp.int32)
-                gain_ok = best["gain"][bl] > 0.0
-                do_split = gain_ok & ~done
+            rvF, rvI, rvB = eval_leaf(
+                root_hist, root_g, root_h, root_count_g,
+                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.int32(0))
+            bestF = bestF.at[0].set(rvF)
+            bestI = bestI.at[0].set(rvI)
+            bestB = bestB.at[0].set(rvB)
 
-                def no_op(_):
-                    return (indices, leaf_begin, leaf_count, leaf_count_glob,
-                            leaf_sum_g,
-                            leaf_sum_h, leaf_depth, leaf_minc, leaf_maxc,
-                            hist_store, best, rec, leaf_value, n_splits,
-                            jnp.asarray(True))
+            state = (jnp.int32(0), indices, leafF, leafI, hist_store,
+                     bestF, bestI, bestB, recF, recI, recB)
 
-                def apply(_):
-                    new_leaf = s + 1
-                    f = best["feature"][bl]
-                    thr = best["threshold"][bl]
-                    dleft = best["default_left"][bl]
-                    iscat = best["is_cat"][bl]
-                    bitset = best["cat_bitset"][bl]
-                    begin = leaf_begin[bl]
-                    count = leaf_count[bl]
-                    bk = self._bucket_index(count, nbk)
-                    new_indices, left_cnt = lax.switch(
-                        bk, part_fns, bins[:, f], indices, begin, count, thr,
-                        dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bitset)
-                    right_cnt = count - left_cnt
-                    # GLOBAL child counts come from the (already psum-reduced)
-                    # histogram's count channel — exact integers in f32
-                    left_cnt_g = best["left_c"][bl]
-                    right_cnt_g = best["right_c"][bl]
+            def cond(state):
+                s = state[0]
+                bestF = state[5]
+                return (s < split_budget) & (jnp.max(bestF[:, BF_GAIN]) > 0.0)
 
-                    # record
-                    rec2 = dict(rec)
-                    rec2["leaf"] = rec["leaf"].at[s].set(bl)
-                    rec2["feature"] = rec["feature"].at[s].set(f)
-                    rec2["threshold_bin"] = rec["threshold_bin"].at[s].set(thr)
-                    rec2["default_left"] = rec["default_left"].at[s].set(dleft)
-                    rec2["is_cat"] = rec["is_cat"].at[s].set(iscat)
-                    rec2["cat_bitset"] = rec["cat_bitset"].at[s].set(bitset)
-                    rec2["left_output"] = rec["left_output"].at[s].set(
-                        best["left_output"][bl])
-                    rec2["right_output"] = rec["right_output"].at[s].set(
-                        best["right_output"][bl])
-                    rec2["left_count"] = rec["left_count"].at[s].set(
-                        left_cnt_g)
-                    rec2["right_count"] = rec["right_count"].at[s].set(
-                        right_cnt_g)
-                    rec2["gain"] = rec["gain"].at[s].set(best["gain"][bl])
-                    rec2["internal_value"] = rec["internal_value"].at[s].set(
-                        leaf_value[bl])
+            def body(state):
+                (s, indices, leafF, leafI, hist_store, bestF, bestI, bestB,
+                 recF, recI, recB) = state
+                bl = jnp.argmax(bestF[:, BF_GAIN]).astype(jnp.int32)
+                new_leaf = s + 1
+                bF = bestF[bl]
+                bI = bestI[bl]
+                bB = bestB[bl]
+                f = bI[BI_FEAT]
+                thr = bI[BI_THR]
+                dleft = bI[BI_DEFLEFT] != 0
+                iscat = bI[BI_ISCAT] != 0
+                begin = leafI[bl, LI_BEGIN]
+                count = leafI[bl, LI_COUNT]
+                # contiguous column read from the transposed bins
+                bins_col = lax.dynamic_slice(
+                    bins_T, (f, jnp.int32(0)), (1, bins_T.shape[1]))[0]
+                bk = self._bucket_index(count, nbk)
+                new_indices, left_cnt = lax.switch(
+                    bk, part_fns, bins_col, indices, begin, count, thr,
+                    dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bB)
+                right_cnt = count - left_cnt
+                # GLOBAL child counts come from the (already psum-reduced)
+                # histogram's count channel — exact integers in f32
+                left_cnt_g = bI[BI_LC]
+                right_cnt_g = bI[BI_RC]
 
-                    lv = leaf_value.at[bl].set(best["left_output"][bl])
-                    lv = lv.at[new_leaf].set(best["right_output"][bl])
+                # ---- packed record row
+                rowF = jnp.stack([bF[BF_LOUT], bF[BF_ROUT], bF[BF_GAIN],
+                                  leafF[bl, LF_VALUE]])
+                rowI = jnp.zeros(RI_W, jnp.int32)
+                rowI = rowI.at[RI_LEAF].set(bl)
+                rowI = rowI.at[RI_FEAT].set(f)
+                rowI = rowI.at[RI_THR].set(thr)
+                rowI = rowI.at[RI_DEFLEFT].set(bI[BI_DEFLEFT])
+                rowI = rowI.at[RI_ISCAT].set(bI[BI_ISCAT])
+                rowI = rowI.at[RI_LC].set(left_cnt_g)
+                rowI = rowI.at[RI_RC].set(right_cnt_g)
+                recF = recF.at[s].set(rowF)
+                recI = recI.at[s].set(rowI)
+                recB = recB.at[s].set(bB)
 
-                    # children bookkeeping
-                    lb = leaf_begin.at[new_leaf].set(begin + left_cnt)
-                    lc_ = leaf_count.at[bl].set(left_cnt)
-                    lc_ = lc_.at[new_leaf].set(right_cnt)
-                    lcg = leaf_count_glob.at[bl].set(left_cnt_g)
-                    lcg = lcg.at[new_leaf].set(right_cnt_g)
-                    depth = leaf_depth[bl] + 1
-                    ld = leaf_depth.at[bl].set(depth)
-                    ld = ld.at[new_leaf].set(depth)
-                    lsg = leaf_sum_g.at[bl].set(best["left_g"][bl])
-                    lsg = lsg.at[new_leaf].set(best["right_g"][bl])
-                    lsh = leaf_sum_h.at[bl].set(best["left_h"][bl])
-                    lsh = lsh.at[new_leaf].set(best["right_h"][bl])
+                # ---- children bookkeeping (two packed-row writes)
+                depth = leafI[bl, LI_DEPTH] + 1
+                # monotone constraint propagation
+                if self._mono_any:
+                    mono = mono_dev[f]
+                    mid = (bF[BF_LOUT] + bF[BF_ROUT]) / 2.0
+                    minc0 = leafF[bl, LF_MINC]
+                    maxc0 = leafF[bl, LF_MAXC]
+                    lmax = jnp.where(mono > 0, jnp.minimum(maxc0, mid), maxc0)
+                    rmin = jnp.where(mono > 0, jnp.maximum(minc0, mid), minc0)
+                    lmin = jnp.where(mono < 0, jnp.maximum(minc0, mid), minc0)
+                    rmax = jnp.where(mono < 0, jnp.minimum(maxc0, mid), maxc0)
+                else:
+                    lmin = rmin = leafF[bl, LF_MINC]
+                    lmax = rmax = leafF[bl, LF_MAXC]
+                lrowF = jnp.zeros(LF_W, jnp.float32)
+                lrowF = lrowF.at[LF_SG].set(bF[BF_LG])
+                lrowF = lrowF.at[LF_SH].set(bF[BF_LH])
+                lrowF = lrowF.at[LF_MINC].set(lmin)
+                lrowF = lrowF.at[LF_MAXC].set(lmax)
+                lrowF = lrowF.at[LF_VALUE].set(bF[BF_LOUT])
+                rrowF = jnp.zeros(LF_W, jnp.float32)
+                rrowF = rrowF.at[LF_SG].set(bF[BF_RG])
+                rrowF = rrowF.at[LF_SH].set(bF[BF_RH])
+                rrowF = rrowF.at[LF_MINC].set(rmin)
+                rrowF = rrowF.at[LF_MAXC].set(rmax)
+                rrowF = rrowF.at[LF_VALUE].set(bF[BF_ROUT])
+                leafF = leafF.at[bl].set(lrowF)
+                leafF = leafF.at[new_leaf].set(rrowF)
+                lrowI = jnp.stack([begin, left_cnt, left_cnt_g, depth,
+                                   jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                                   jnp.int32(0)])
+                rrowI = jnp.stack([begin + left_cnt, right_cnt, right_cnt_g,
+                                   depth, jnp.int32(0), jnp.int32(0),
+                                   jnp.int32(0), jnp.int32(0)])
+                leafI = leafI.at[bl].set(lrowI)
+                leafI = leafI.at[new_leaf].set(rrowI)
 
-                    # monotone constraint propagation
-                    if self._mono_any:
-                        mono = jnp.asarray(self.meta["monotone"],
-                                           jnp.int32)[f]
-                        mid = (best["left_output"][bl]
-                               + best["right_output"][bl]) / 2.0
-                        lmax = jnp.where(mono > 0,
-                                         jnp.minimum(leaf_maxc[bl], mid),
-                                         leaf_maxc[bl])
-                        rmin = jnp.where(mono > 0,
-                                         jnp.maximum(leaf_minc[bl], mid),
-                                         leaf_minc[bl])
-                        lmin = jnp.where(mono < 0,
-                                         jnp.maximum(leaf_minc[bl], mid),
-                                         leaf_minc[bl])
-                        rmax = jnp.where(mono < 0,
-                                         jnp.minimum(leaf_maxc[bl], mid),
-                                         leaf_maxc[bl])
-                        lminc = leaf_minc.at[bl].set(lmin)
-                        lminc = lminc.at[new_leaf].set(rmin)
-                        lmaxc = leaf_maxc.at[bl].set(lmax)
-                        lmaxc = lmaxc.at[new_leaf].set(rmax)
-                    else:
-                        lminc, lmaxc = leaf_minc, leaf_maxc
+                # histogram: construct smaller child, subtract for larger.
+                # "Smaller" is decided on GLOBAL counts so every shard
+                # histograms the same child (the reference uses
+                # GetGlobalDataCountInLeaf the same way,
+                # data_parallel_tree_learner.cpp:198-220); each shard
+                # gathers its LOCAL slice of that child.
+                smaller_is_left = left_cnt_g <= right_cnt_g
+                sm_begin = jnp.where(smaller_is_left, begin,
+                                     begin + left_cnt)
+                sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
+                bk2 = self._bucket_index(sm_count, nbk)
+                sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
+                                     grad, hess, sm_begin, sm_count)
+                sm_hist = _gsum_hist(sm_hist)
+                lg_hist = hist_store[bl] - sm_hist
+                left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
+                right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
+                hist_store = hist_store.at[bl].set(left_hist)
+                hist_store = hist_store.at[new_leaf].set(right_hist)
 
-                    # histogram: construct smaller child, subtract for larger.
-                    # "Smaller" is decided on GLOBAL counts so every shard
-                    # histograms the same child (the reference uses
-                    # GetGlobalDataCountInLeaf the same way,
-                    # data_parallel_tree_learner.cpp:198-220); each shard
-                    # gathers its LOCAL slice of that child.
-                    smaller_is_left = left_cnt_g <= right_cnt_g
-                    sm_begin = jnp.where(smaller_is_left, begin,
-                                         begin + left_cnt)
-                    sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
-                    bk2 = self._bucket_index(sm_count, nbk)
-                    sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
-                                         grad, hess, sm_begin, sm_count)
-                    sm_hist = _gsum_hist(sm_hist)
-                    lg_hist = hist_store[bl] - sm_hist
-                    left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
-                    right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
-                    hs = hist_store.at[bl].set(left_hist)
-                    hs = hs.at[new_leaf].set(right_hist)
+                # evaluate both children (global counts)
+                lF, lI, lB = eval_leaf(left_hist, bF[BF_LG], bF[BF_LH],
+                                       left_cnt_g, lmin, lmax, depth)
+                rF, rI, rB = eval_leaf(right_hist, bF[BF_RG], bF[BF_RH],
+                                       right_cnt_g, rmin, rmax, depth)
+                bestF = bestF.at[bl].set(lF)
+                bestF = bestF.at[new_leaf].set(rF)
+                bestI = bestI.at[bl].set(lI)
+                bestI = bestI.at[new_leaf].set(rI)
+                bestB = bestB.at[bl].set(lB)
+                bestB = bestB.at[new_leaf].set(rB)
 
-                    # evaluate both children (global counts)
-                    lbst = eval_leaf(left_hist, lsg[bl], lsh[bl], left_cnt_g,
-                                     lminc[bl], lmaxc[bl], depth)
-                    rbst = eval_leaf(right_hist, lsg[new_leaf],
-                                     lsh[new_leaf], right_cnt_g,
-                                     lminc[new_leaf], lmaxc[new_leaf], depth)
-                    best2 = dict(best)
-                    for k in best2:
-                        best2[k] = best2[k].at[bl].set(lbst[k])
-                        best2[k] = best2[k].at[new_leaf].set(rbst[k])
+                return (s + 1, new_indices, leafF, leafI, hist_store,
+                        bestF, bestI, bestB, recF, recI, recB)
 
-                    return (new_indices, lb, lc_, lcg, lsg, lsh, ld, lminc,
-                            lmaxc, hs, best2, rec2, lv, n_splits + 1, done)
-
-                return lax.cond(do_split, apply, no_op, None)
-
-            (indices, leaf_begin, leaf_count, leaf_count_glob,
-             leaf_sum_g, leaf_sum_h,
-             leaf_depth, leaf_minc, leaf_maxc, hist_store, best, rec,
-             leaf_value, n_splits, done) = lax.fori_loop(
-                0, max(L - 1, 0), body, state)
+            (n_splits, indices, leafF, leafI, hist_store, bestF, bestI,
+             bestB, recF, recI, recB) = lax.while_loop(cond, body, state)
 
             record = TreeRecord(
                 num_splits=n_splits,
-                leaf=rec["leaf"], feature=rec["feature"],
-                threshold_bin=rec["threshold_bin"],
-                default_left=rec["default_left"], is_cat=rec["is_cat"],
-                cat_bitset=rec["cat_bitset"],
-                left_output=rec["left_output"],
-                right_output=rec["right_output"],
-                left_count=rec["left_count"], right_count=rec["right_count"],
-                gain=rec["gain"], internal_value=rec["internal_value"],
-                leaf_value=leaf_value, leaf_count_arr=leaf_count_glob,
-                leaf_begin=leaf_begin, leaf_cnt_part=leaf_count)
+                leaf=recI[:, RI_LEAF], feature=recI[:, RI_FEAT],
+                threshold_bin=recI[:, RI_THR],
+                default_left=recI[:, RI_DEFLEFT] != 0,
+                is_cat=recI[:, RI_ISCAT] != 0,
+                cat_bitset=recB,
+                left_output=recF[:, RF_LOUT],
+                right_output=recF[:, RF_ROUT],
+                left_count=recI[:, RI_LC], right_count=recI[:, RI_RC],
+                gain=recF[:, RF_GAIN], internal_value=recF[:, RF_IVAL],
+                leaf_value=leafF[:, LF_VALUE],
+                leaf_count_arr=leafI[:, LI_COUNTG],
+                leaf_begin=leafI[:, LI_BEGIN],
+                leaf_cnt_part=leafI[:, LI_COUNT])
             return indices, record
 
         if self.axis_name is not None:
             return build  # caller wraps in shard_map + jit
-        return jax.jit(build, donate_argnums=(1,))
+        return jax.jit(build, donate_argnums=(2,))
 
     # ------------------------------------------------------------------
     def init_root_partition(self, bag_indices, bag_cnt: int):
@@ -588,22 +645,25 @@ class DeviceTreeLearner:
 
     def train(self, grad: jax.Array, hess: jax.Array,
               indices: jax.Array, root_count: int,
-              feature_mask: Optional[np.ndarray] = None
+              feature_mask: Optional[np.ndarray] = None,
+              root_contiguous: bool = False
               ) -> Tuple[jax.Array, TreeRecord]:
         """Grow one tree; returns (new partition indices, TreeRecord).
         `indices` must be padded so begin+bucket_size never overflows
-        (length n + pow2ceil(n))."""
+        (length n + pow2ceil(n)). Pass root_contiguous=True when `indices`
+        is the identity permutation (no bagging, fresh partition)."""
         root_padded = max(_pow2ceil(root_count), self.min_pad)
-        fn = self._build_cache.get(root_padded)
+        key = (root_padded, bool(root_contiguous))
+        fn = self._build_cache.get(key)
         if fn is None:
-            fn = self._make_build_fn(root_padded)
-            self._build_cache[root_padded] = fn
+            fn = self._make_build_fn(root_padded, bool(root_contiguous))
+            self._build_cache[key] = fn
         if feature_mask is None:
             fmask = jnp.ones(self.num_features, jnp.float32)
         else:
             fmask = jnp.asarray(feature_mask.astype(np.float32))
-        return fn(self.bins_dev, indices, grad, hess, jnp.int32(root_count),
-                  fmask)
+        return fn(self.bins_dev, self.bins_T_dev, indices, grad, hess,
+                  jnp.int32(root_count), fmask)
 
     # ------------------------------------------------------------------
     def record_to_tree(self, rec_host, shrinkage: float = 1.0) -> Tree:

@@ -344,10 +344,14 @@ class GBDT:
         """Fused path: whole-tree device programs, no mid-iteration host
         syncs; empty-tree detection is deferred and batched."""
         cfg = self.cfg
-        idxs, count = self.learner.init_root_partition(
-            self.bag_data_indices, self.bag_data_cnt)
+        bagged = self.bag_data_indices is not None
         any_trained = False
         for k in range(self.num_tree_per_iteration):
+            # fresh identity partition per tree: keeps the root histogram
+            # contiguous (no random gather of the full dataset) and makes
+            # the partition-based score update exact
+            idxs, count = self.learner.init_root_partition(
+                self.bag_data_indices, self.bag_data_cnt)
             # fresh column sample per tree, like SerialTreeLearner
             fmask = self.learner.feature_mask()
             if not self._class_need_train[k] \
@@ -359,17 +363,27 @@ class GBDT:
                 continue
             any_trained = True
             idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
-                                           fmask)
+                                           fmask, root_contiguous=not bagged)
             lazy = LazyTree(rec, self.shrinkage_rate, init_scores[k],
                             self.learner, max(cfg.num_leaves - 1, 1))
             self.models.append(lazy)
-            # device score updates via record traversal (sharded over the
-            # mesh in data-parallel mode)
-            trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
-            self.train_score.score = self.train_score.score.at[k].set(
-                self.learner.add_score(self.train_score.score[k], trav,
-                                       self.shrinkage_rate))
+            if not bagged:
+                # partition-based score update: leaf fill + one key-sort back
+                # to row order (no per-level tree traversal)
+                self.train_score.score = self.train_score.score.at[k].set(
+                    self.learner.add_score_from_partition(
+                        self.train_score.score[k], rec, idxs, count,
+                        self.shrinkage_rate))
+                trav = None
+            else:
+                # bagged: out-of-bag rows also need scores -> traversal
+                trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
+                self.train_score.score = self.train_score.score.at[k].set(
+                    self.learner.add_score(self.train_score.score[k], trav,
+                                           self.shrinkage_rate))
             for i, su in enumerate(self.valid_scores):
+                if trav is None:
+                    trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
                 vb = self._valid_bins_dev[i]
                 su.score = su.score.at[k].set(
                     add_record_score(su.score[k], vb, trav, self._trav_nb,

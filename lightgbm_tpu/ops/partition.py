@@ -82,6 +82,42 @@ def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
     return new_indices, left_count
 
 
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def leaf_value_fill(leaf_begin: jax.Array, leaf_count: jax.Array,
+                    leaf_value: jax.Array, n_pad: int) -> jax.Array:
+    """Per-POSITION leaf values from the final partition: leaves are disjoint
+    contiguous [begin, begin+count) segments, so a difference array with
+    +value at each begin and -value at each end, cumsum'd, yields the value
+    of the covering leaf at every position — L tiny scatters + one prefix
+    sum instead of a per-row tree traversal.
+    """
+    v = jnp.where(leaf_count > 0, leaf_value, 0.0)
+    d = jnp.zeros(n_pad + 1, jnp.float32)
+    d = d.at[jnp.where(leaf_count > 0, leaf_begin, n_pad)].add(v)
+    d = d.at[jnp.where(leaf_count > 0, leaf_begin + leaf_count, n_pad)].add(-v)
+    return jnp.cumsum(d[:-1])
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def unpermute_to_rows(indices: jax.Array, values: jax.Array,
+                      count: jax.Array, n: int) -> jax.Array:
+    """Map per-POSITION values back to per-ROW order: position p holds row id
+    `indices[p]`, so sorting (key=row id, payload=value) recovers row order.
+    A key-sort moves data through regular compare-exchange networks — far
+    faster on TPU than a 1-element random scatter/gather per row.
+
+    Requires `indices[:count]` to be a permutation of [0, n) (fresh
+    no-bagging partition); positions beyond `count` get key n+p so they sort
+    to the tail. Bagged iterations must use the traversal path instead
+    (out-of-bag rows also need scores, reference gbdt.cpp:487-506).
+    """
+    n_pad = indices.shape[0]
+    pos = jnp.arange(n_pad, dtype=jnp.int32)
+    key = jnp.where(pos < count, indices, n + pos)
+    _, sval = lax.sort([key, values], num_keys=1)
+    return lax.slice(sval, (0,), (n,))
+
+
 @functools.partial(jax.jit, static_argnames=("n", "n_pad"))
 def init_partition(n: int, n_pad: int) -> jax.Array:
     """Root partition: identity permutation; the tail repeats row n-1 (tail

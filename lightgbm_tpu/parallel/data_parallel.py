@@ -73,6 +73,11 @@ class DataParallelTreeLearner:
             bins_np = np.pad(bins_np, ((0, self.pad_rows), (0, 0)))
         shard = NamedSharding(self.mesh, P(self.axis_name))
         self.bins_sharded = jax.device_put(bins_np, shard)
+        # transposed copy, row-sharded along its second axis, for the
+        # contiguous split-column reads inside the tree build
+        self.bins_T_sharded = jax.device_put(
+            np.ascontiguousarray(bins_np.T),
+            NamedSharding(self.mesh, P(None, self.axis_name)))
         self._row_shard = shard
         self._fn_cache = {}
 
@@ -86,34 +91,53 @@ class DataParallelTreeLearner:
     def init_root_partition(self, bag_indices: Optional[np.ndarray],
                             bag_cnt: int) -> Tuple[jax.Array, jax.Array]:
         """Per-shard local partitions: shard s owns global rows
-        [s*per, (s+1)*per); local indices are block-relative."""
+        [s*per, (s+1)*per); local indices are block-relative. The no-bagging
+        identity partition is built ON DEVICE (a fresh iota per call — the
+        train step donates/consumes the buffer), avoiding a per-tree
+        host build + transfer."""
+        if bag_indices is None:
+            fn = self._fn_cache.get("identity_part")
+            if fn is None:
+                nd, per, llen, n = (self.nd, self.per_shard,
+                                    self.local_idx_len, self.n)
+                shard = self._row_shard
+
+                def make():
+                    pos = jnp.arange(nd * llen, dtype=jnp.int32)
+                    local = pos % llen
+                    s = pos // llen
+                    cnt = jnp.minimum(
+                        jnp.maximum(n - jnp.arange(nd, dtype=jnp.int32) * per,
+                                    0), per)
+                    idxs = jnp.where(local < cnt[s], local, 0)
+                    return idxs, cnt
+
+                fn = jax.jit(make, out_shardings=(shard, shard))
+                self._fn_cache["identity_part"] = fn
+            return fn()
         idxs = np.zeros((self.nd, self.local_idx_len), np.int32)
         counts = np.zeros(self.nd, np.int32)
         for s in range(self.nd):
             lo, hi = s * self.per_shard, (s + 1) * self.per_shard
-            if bag_indices is None:
-                c = max(0, min(hi, self.n) - lo)
-                idxs[s, :c] = np.arange(c, dtype=np.int32)
-            else:
-                sel = bag_indices[(bag_indices >= lo) & (bag_indices < hi)]
-                c = len(sel)
-                idxs[s, :c] = (sel - lo).astype(np.int32)
+            sel = bag_indices[(bag_indices >= lo) & (bag_indices < hi)]
+            c = len(sel)
+            idxs[s, :c] = (sel - lo).astype(np.int32)
             counts[s] = c
         shard = self._row_shard
         return (jax.device_put(idxs.reshape(-1), shard),
                 jax.device_put(counts, shard))
 
     # ------------------------------------------------------------------
-    def _sharded_train_fn(self):
-        key = self.local_pad
+    def _sharded_train_fn(self, root_contiguous: bool):
+        key = (self.local_pad, root_contiguous)
         fn = self._fn_cache.get(key)
         if fn is not None:
             return fn
-        build = self.inner._make_build_fn(self.local_pad)
+        build = self.inner._make_build_fn(self.local_pad, root_contiguous)
         ax = self.axis_name
 
-        def per_shard(bins, indices, grad, hess, counts, fmask):
-            return build(bins, indices, grad, hess, counts[0], fmask)
+        def per_shard(bins, bins_T, indices, grad, hess, counts, fmask):
+            return build(bins, bins_T, indices, grad, hess, counts[0], fmask)
 
         rec_specs = TreeRecord(
             num_splits=P(), leaf=P(), feature=P(), threshold_bin=P(),
@@ -124,18 +148,18 @@ class DataParallelTreeLearner:
             leaf_begin=P(ax), leaf_cnt_part=P(ax))
         mapped = jax.shard_map(
             per_shard, mesh=self.mesh,
-            in_specs=(P(ax), P(ax), P(ax), P(ax), P(ax), P()),
+            in_specs=(P(ax), P(None, ax), P(ax), P(ax), P(ax), P(ax), P()),
             out_specs=(P(ax), rec_specs),
             check_vma=False)
 
-        def run(bins, indices, grad, hess, counts, fmask):
+        def run(bins, bins_T, indices, grad, hess, counts, fmask):
             pad = self.nd * self.per_shard - grad.shape[0]
             if pad:
                 grad = jnp.pad(grad, (0, pad))
                 hess = jnp.pad(hess, (0, pad))
-            return mapped(bins, indices, grad, hess, counts, fmask)
+            return mapped(bins, bins_T, indices, grad, hess, counts, fmask)
 
-        fn = jax.jit(run, donate_argnums=(1,))
+        fn = jax.jit(run, donate_argnums=(2,))
         self._fn_cache[key] = fn
         return fn
 
@@ -172,13 +196,57 @@ class DataParallelTreeLearner:
         """Sharded score update: each shard traverses only its row block."""
         return self._score_fn()(score_row, trav, jnp.float32(scale))
 
+    def _partition_score_fn(self):
+        fn = self._fn_cache.get("pscore")
+        if fn is not None:
+            return fn
+        ax = self.axis_name
+        from ..ops.partition import leaf_value_fill, unpermute_to_rows
+        local_len = self.local_idx_len
+        per = self.per_shard
+
+        def per_shard(score, leaf_begin, leaf_cnt, leaf_value, indices,
+                      counts, scale):
+            fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value,
+                                   local_len)
+            delta = unpermute_to_rows(indices, fill, counts[0], per)
+            return score + scale * delta
+
+        mapped = jax.shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(P(ax), P(ax), P(ax), P(), P(ax), P(ax), P()),
+            out_specs=P(ax), check_vma=False)
+
+        def run(score_row, leaf_begin, leaf_cnt, leaf_value, indices,
+                counts, scale):
+            pad = self.nd * per - score_row.shape[0]
+            padded = jnp.pad(score_row, (0, pad)) if pad else score_row
+            out = mapped(padded, leaf_begin, leaf_cnt, leaf_value, indices,
+                         counts, scale)
+            return out[:score_row.shape[0]] if pad else out
+
+        fn = jax.jit(run)
+        self._fn_cache["pscore"] = fn
+        return fn
+
+    def add_score_from_partition(self, score_row: jax.Array,
+                                 record: TreeRecord, indices: jax.Array,
+                                 counts, scale: float) -> jax.Array:
+        """Partition-based score update, per shard: leaf fill over the local
+        partition + one key-sort back to the shard's row-block order."""
+        return self._partition_score_fn()(
+            score_row, record.leaf_begin, record.leaf_cnt_part,
+            record.leaf_value, indices, counts, jnp.float32(scale))
+
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array, indices: jax.Array,
-              counts: jax.Array, feature_mask: Optional[np.ndarray] = None
+              counts: jax.Array, feature_mask: Optional[np.ndarray] = None,
+              root_contiguous: bool = False
               ) -> Tuple[jax.Array, TreeRecord]:
         if feature_mask is None:
             fmask = jnp.ones(self.inner.num_features, jnp.float32)
         else:
             fmask = jnp.asarray(feature_mask.astype(np.float32))
-        fn = self._sharded_train_fn()
-        return fn(self.bins_sharded, indices, grad, hess, counts, fmask)
+        fn = self._sharded_train_fn(bool(root_contiguous))
+        return fn(self.bins_sharded, self.bins_T_sharded, indices, grad,
+                  hess, counts, fmask)

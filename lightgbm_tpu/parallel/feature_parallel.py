@@ -74,19 +74,20 @@ class FeatureParallelTreeLearner:
         return self.inner.init_root_partition(bag_indices, bag_cnt)
 
     # ------------------------------------------------------------------
-    def _sharded_train_fn(self, root_padded: int):
-        fn = self._fn_cache.get(root_padded)
+    def _sharded_train_fn(self, root_padded: int, root_contiguous: bool):
+        key = (root_padded, root_contiguous)
+        fn = self._fn_cache.get(key)
         if fn is not None:
             return fn
-        build = self.inner._make_build_fn(root_padded)
+        build = self.inner._make_build_fn(root_padded, root_contiguous)
         rec_specs = TreeRecord(*([P()] * len(TreeRecord._fields)))
         mapped = jax.shard_map(
             build, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(), P(), P()),
             out_specs=(P(), rec_specs),
             check_vma=False)
         fn = jax.jit(mapped)
-        self._fn_cache[root_padded] = fn
+        self._fn_cache[key] = fn
         return fn
 
     def add_score(self, score_row: jax.Array, trav, scale: float) -> jax.Array:
@@ -94,7 +95,8 @@ class FeatureParallelTreeLearner:
 
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array, indices: jax.Array,
-              root_count: int, feature_mask: Optional[np.ndarray] = None
+              root_count: int, feature_mask: Optional[np.ndarray] = None,
+              root_contiguous: bool = False
               ) -> Tuple[jax.Array, TreeRecord]:
         root_padded = max(_pow2ceil(int(root_count)), self.inner.min_pad)
         if feature_mask is None:
@@ -103,6 +105,6 @@ class FeatureParallelTreeLearner:
             fmask = jnp.ones(self.inner.num_features, jnp.float32)
         else:
             fmask = jnp.asarray(feature_mask.astype(np.float32))
-        fn = self._sharded_train_fn(root_padded)
-        return fn(self.bins_repl, indices, grad, hess, jnp.int32(root_count),
-                  fmask)
+        fn = self._sharded_train_fn(root_padded, bool(root_contiguous))
+        return fn(self.bins_repl, self.inner.bins_T_dev, indices, grad, hess,
+                  jnp.int32(root_count), fmask)
