@@ -27,3 +27,11 @@ try:  # sklearn API is optional (mirrors the reference's compat gating)
     __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
 except ImportError:  # pragma: no cover
     pass
+
+try:  # plotting is optional (matplotlib / graphviz)
+    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
+    __all__ += ["plot_importance", "plot_split_value_histogram",
+                "plot_metric", "plot_tree", "create_tree_digraph"]
+except ImportError:  # pragma: no cover
+    pass

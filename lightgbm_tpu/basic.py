@@ -485,6 +485,38 @@ class Booster:
             return list(self._gbdt.train_data.feature_names)
         return list(self._loaded.get("feature_names") or [])
 
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of a feature's numerical split thresholds across all
+        trees (reference Booster.get_split_value_histogram,
+        basic.py:2470+). Returns (hist, bin_edges) or, with xgboost_style,
+        a pandas DataFrame / ndarray of (SplitValue, Count)."""
+        if isinstance(feature, str):
+            names = self.feature_name()
+            if feature not in names:
+                raise LightGBMError(f"Unknown feature name {feature}")
+            feature = names.index(feature)
+        values = []
+        for t in self.trees:
+            for node in range(max(t.num_leaves - 1, 0)):
+                if t.split_feature[node] == feature \
+                        and not t.node_is_categorical(node):
+                    values.append(float(t.threshold[node]))
+        values = np.asarray(values, np.float64)
+        if bins is None or (isinstance(bins, int)
+                            and bins > len(np.unique(values))):
+            bins = max(len(np.unique(values)), 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                import pandas as pd
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, bin_edges
+
     def free_dataset(self) -> "Booster":
         return self
 

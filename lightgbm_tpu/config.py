@@ -345,7 +345,15 @@ class Config:
     gpu_use_dp: bool = False
     tpu_use_f64_hist: bool = False      # accumulate histograms in f64 (2x pass)
     tpu_hist_chunk: int = 1 << 16        # rows per histogram matmul chunk
-    tpu_use_pallas: bool = True          # use pallas histogram kernel when available
+    # pallas VMEM-resident histogram kernel (ops/pallas_hist.py, the
+    # ocl/histogram256.cl analogue); off by default until it beats the XLA
+    # one-hot contraction on the target shapes — flip to measure
+    tpu_use_pallas: bool = False
+    # trace gradients + tree build + score update as ONE program per
+    # boosting iteration (saves per-program launch latency on tunneled
+    # runtimes, but XLA compile time for the merged program is prohibitive
+    # at large row counts — measure before enabling)
+    tpu_fuse_iteration: bool = False
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
     tpu_mesh_axis: str = "data"          # mesh axis name for row sharding
 

@@ -17,8 +17,8 @@ TPU-profile-driven layout choices (v5e measurements):
   partitions make that the common case), and per-split work is bucketed to
   the smaller child's power-of-two size;
 - a TRANSPOSED copy of the bins (`bins_T[F, N]`) makes the split feature's
-  column a contiguous `dynamic_slice` instead of a stride-F gather that cost
-  ~300us/split;
+  column a contiguous `dynamic_slice`, and the stable partition carries row
+  ids through the sort network as a payload operand (no argsort+gather);
 - the per-leaf best-split/record state lives in a few PACKED [L, 8]-wide
   arrays rather than ~26 scalar arrays — each split updates 6 rows, not 40,
   which keeps the sequential tiny-op chain per split short;
@@ -43,7 +43,7 @@ from jax import lax
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered
+from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered_gh
 from ..ops.partition import (categorical_goes_left, leaf_value_fill,
                              numerical_goes_left, split_partition,
                              unpermute_to_rows)
@@ -185,8 +185,14 @@ class DeviceTreeLearner:
         self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
         self.mappers = dataset.used_mappers()
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
-        self.hist_precision = ("f32" if cfg.gpu_use_dp or cfg.tpu_use_f64_hist
-                               else "bf16x2")
+        if cfg.gpu_use_dp or cfg.tpu_use_f64_hist:
+            self.hist_precision = "f32"
+        elif cfg.tpu_use_pallas:
+            from ..ops.pallas_hist import pallas_available
+            self.hist_precision = ("pallas" if pallas_available()
+                                   else "bf16x2")
+        else:
+            self.hist_precision = "bf16x2"
         self.min_pad = int(cfg.tpu_min_pad)
         # device feature metadata for the partition step
         self._nb_dev = jnp.asarray(meta["num_bin"], jnp.int32)
@@ -209,7 +215,7 @@ class DeviceTreeLearner:
         pass over the whole matrix on TPU)."""
         if self._bins_T_dev is None:
             self._bins_T_dev = jnp.asarray(
-                np.ascontiguousarray(self.ds.bins.T))
+                np.ascontiguousarray(np.asarray(self.ds.bins).T))
         return self._bins_T_dev
 
     def add_score(self, score_row: jax.Array, trav: Dict,
@@ -219,20 +225,20 @@ class DeviceTreeLearner:
                                 self._db_dev, self._mt_dev,
                                 jnp.float32(scale))
 
-    def add_score_from_partition(self, score_row: jax.Array,
+    def add_score_from_partition(self, score: jax.Array, class_id: int,
                                  record: "TreeRecord", indices: jax.Array,
-                                 root_count, scale: float) -> jax.Array:
-        """score += scale * tree(x) using the final partition: each leaf's
-        rows are contiguous in `indices`, so the per-row leaf value is a
-        scatter-at-L-boundaries + cumsum fill, and the only irregular step is
-        ONE key-sort back to row order — no per-level tree traversal.
-        (Replaces the reference's Tree::AddPredictionToScore bulk update,
-        tree.cpp:112-204.)"""
-        fill = leaf_value_fill(record.leaf_begin, record.leaf_cnt_part,
-                               record.leaf_value, indices.shape[0])
-        delta = unpermute_to_rows(indices, fill, root_count,
-                                  score_row.shape[0])
-        return score_row + jnp.float32(scale) * delta
+                                 scale: float) -> jax.Array:
+        """score[class_id] += scale * tree(x) using the final partition:
+        each leaf's rows are contiguous in `indices`, so the per-row leaf
+        value is a scatter-at-L-boundaries + cumsum fill, and the only
+        irregular step is ONE key-sort back to row order — no per-level tree
+        traversal. One fused program, score buffer donated. (Replaces the
+        reference's Tree::AddPredictionToScore bulk update,
+        tree.cpp:112-204.) Valid only for full-data (no bagging) trees."""
+        return _partition_score_update(
+            score, jnp.int32(class_id), record.leaf_begin,
+            record.leaf_cnt_part, record.leaf_value, indices,
+            jnp.int32(self.n), jnp.float32(scale))
 
     # ------------------------------------------------------------------
     def feature_mask(self) -> Optional[np.ndarray]:
@@ -306,10 +312,11 @@ class DeviceTreeLearner:
                     self.hyper.min_sum_hessian_in_leaf / m))
             finder_local = make_split_finder(hyper_local, self.meta, B)
 
-        def _feature_block_hist(rows, g, h, valid):
+
+        def _feature_block_hist(rows, gh, valid):
             if mode != "feature":
-                return histogram_from_gathered(rows, g, h, valid, B, chunk,
-                                               precision)
+                return histogram_from_gathered_gh(rows, gh, valid, B, chunk,
+                                                  precision)
             # feature-parallel: each shard histograms only its feature block
             # (reference feature_parallel_tree_learner.cpp:33-52 work
             # division); the psum that follows assembles the global
@@ -318,21 +325,19 @@ class DeviceTreeLearner:
             size = rows.shape[0]
             rows = lax.dynamic_slice(rows, (jnp.int32(0), start),
                                      (size, f_block))
-            hb = histogram_from_gathered(rows, g, h, valid, B, chunk,
-                                         precision)
+            hb = histogram_from_gathered_gh(rows, gh, valid, B, chunk,
+                                            precision)
             full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
             return lax.dynamic_update_slice(
                 full, hb, (start, jnp.int32(0), jnp.int32(0)))
 
         def hist_bucket(size):
-            def fn(bins, indices, grad, hess, begin, count):
+            def fn(bins, indices, gh, begin, count):
                 idx = lax.dynamic_slice(indices, (begin,), (size,))
                 pos = jnp.arange(size, dtype=jnp.int32)
                 valid = pos < count
                 safe = jnp.where(valid, idx, 0)
-                rows = bins[safe]
-                return _feature_block_hist(rows, grad[safe], hess[safe],
-                                           valid)
+                return _feature_block_hist(bins[safe], gh[safe], valid)
             return fn
 
         def part_bucket(size):
@@ -371,8 +376,36 @@ class DeviceTreeLearner:
         # only the (>=1) record-array length
         split_budget = max(L - 1, 0)
 
+        # local row count for fresh (identity-partition) builds: static for
+        # replicated-row modes, per-shard via axis_index for rows-sharded
+        rows_sharded = axis is not None and mode in ("data", "voting")
+        per_shard_rows = (int(math.ceil(self.n / max(self.mesh_size, 1)))
+                          if rows_sharded else self.n)
+
+        def build_fresh(bins, bins_T, grad, hess, feature_mask_f32):
+            """Fresh-tree entry: creates the identity partition internally
+            (one fused program instead of init-partition + build
+            dispatches); only valid without bagging."""
+            n_pad = per_shard_rows + max(_pow2ceil(per_shard_rows),
+                                         self.min_pad)
+            pos = jnp.arange(n_pad, dtype=jnp.int32)
+            if rows_sharded:
+                s = lax.axis_index(axis)
+                cnt = jnp.clip(self.n - s * per_shard_rows, 0,
+                               per_shard_rows).astype(jnp.int32)
+            else:
+                cnt = jnp.int32(per_shard_rows)
+            indices = jnp.where(pos < cnt, pos, 0)
+            gh = jnp.stack([grad, hess], axis=1)
+            return _build(bins, bins_T, indices, gh, cnt, feature_mask_f32)
+
         def build(bins, bins_T, indices, grad, hess, root_count,
                   feature_mask_f32):
+            gh = jnp.stack([grad, hess], axis=1)
+            return _build(bins, bins_T, indices, gh, root_count,
+                          feature_mask_f32)
+
+        def _build(bins, bins_T, indices, gh, root_count, feature_mask_f32):
 
             def _mask_gain(gain, depth):
                 gain = jnp.where(feature_mask_f32 > 0, gain, NEG_INF)
@@ -438,21 +471,20 @@ class DeviceTreeLearner:
                 # identity partition: read the head of bins/grad/hess
                 # directly (static slice, no gather); pow2 padding can
                 # exceed the physical row count, so clamp statically
-                rp = min(root_padded, bins.shape[0], grad.shape[0])
+                rp = min(root_padded, bins.shape[0], gh.shape[0])
                 pos = jnp.arange(rp, dtype=jnp.int32)
                 valid = pos < root_count
                 rows = lax.slice(bins, (0, 0), (rp, F))
-                g0 = lax.slice(grad, (0,), (rp,))
-                h0 = lax.slice(hess, (0,), (rp,))
-                root_hist = _feature_block_hist(rows, g0, h0, valid)
-                root_g = jnp.sum(jnp.where(valid, g0, 0.0))
-                root_h = jnp.sum(jnp.where(valid, h0, 0.0))
+                gh0 = lax.slice(gh, (0, 0), (rp, 2))
+                root_hist = _feature_block_hist(rows, gh0, valid)
+                sums = jnp.sum(jnp.where(valid[:, None], gh0, 0.0), axis=0)
+                root_g, root_h = sums[0], sums[1]
             else:
                 bsel = self._bucket_index(root_count, nbk)
                 root_hist = lax.switch(
-                    bsel, hist_fns, bins, indices, grad, hess, jnp.int32(0),
+                    bsel, hist_fns, bins, indices, gh, jnp.int32(0),
                     root_count)
-                root_g, root_h = _masked_sums(indices, grad, hess, root_count,
+                root_g, root_h = _masked_sums(indices, gh, root_count,
                                               root_padded)
             root_hist = _gsum_hist(root_hist)
             # root grad/hess sums (data-parallel: the root-sums allreduce,
@@ -507,6 +539,15 @@ class DeviceTreeLearner:
                 iscat = bI[BI_ISCAT] != 0
                 begin = leafI[bl, LI_BEGIN]
                 count = leafI[bl, LI_COUNT]
+                # GLOBAL child counts come from the (already psum-reduced)
+                # histogram's count channel — exact integers in f32.
+                # "Smaller" is decided on GLOBAL counts so every shard
+                # histograms the same child (the reference uses
+                # GetGlobalDataCountInLeaf the same way,
+                # data_parallel_tree_learner.cpp:198-220).
+                left_cnt_g = bI[BI_LC]
+                right_cnt_g = bI[BI_RC]
+                smaller_is_left = left_cnt_g <= right_cnt_g
                 # contiguous column read from the transposed bins
                 bins_col = lax.dynamic_slice(
                     bins_T, (f, jnp.int32(0)), (1, bins_T.shape[1]))[0]
@@ -515,10 +556,6 @@ class DeviceTreeLearner:
                     bk, part_fns, bins_col, indices, begin, count, thr,
                     dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bB)
                 right_cnt = count - left_cnt
-                # GLOBAL child counts come from the (already psum-reduced)
-                # histogram's count channel — exact integers in f32
-                left_cnt_g = bI[BI_LC]
-                right_cnt_g = bI[BI_RC]
 
                 # ---- packed record row
                 rowF = jnp.stack([bF[BF_LOUT], bF[BF_ROUT], bF[BF_GAIN],
@@ -573,19 +610,15 @@ class DeviceTreeLearner:
                 leafI = leafI.at[bl].set(lrowI)
                 leafI = leafI.at[new_leaf].set(rrowI)
 
-                # histogram: construct smaller child, subtract for larger.
-                # "Smaller" is decided on GLOBAL counts so every shard
-                # histograms the same child (the reference uses
-                # GetGlobalDataCountInLeaf the same way,
-                # data_parallel_tree_learner.cpp:198-220); each shard
-                # gathers its LOCAL slice of that child.
-                smaller_is_left = left_cnt_g <= right_cnt_g
+                # histogram the smaller child (by GLOBAL counts, so every
+                # shard histograms the same child); larger = parent - smaller
+                # (FeatureHistogram::Subtract)
                 sm_begin = jnp.where(smaller_is_left, begin,
                                      begin + left_cnt)
                 sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
                 bk2 = self._bucket_index(sm_count, nbk)
                 sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
-                                     grad, hess, sm_begin, sm_count)
+                                     gh, sm_begin, sm_count)
                 sm_hist = _gsum_hist(sm_hist)
                 lg_hist = hist_store[bl] - sm_hist
                 left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
@@ -628,9 +661,12 @@ class DeviceTreeLearner:
                 leaf_cnt_part=leafI[:, LI_COUNT])
             return indices, record
 
+        fn = build_fresh if root_contiguous else build
         if self.axis_name is not None:
-            return build  # caller wraps in shard_map + jit
-        return jax.jit(build, donate_argnums=(2,))
+            return fn  # caller wraps in shard_map + jit
+        if root_contiguous:
+            return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=(2,))
 
     # ------------------------------------------------------------------
     def init_root_partition(self, bag_indices, bag_cnt: int):
@@ -643,27 +679,72 @@ class DeviceTreeLearner:
                     bag_cnt)
         return init_partition(self.n, n_pad), self.n
 
+    def _fmask_arr(self, feature_mask: Optional[np.ndarray]) -> jax.Array:
+        if feature_mask is None:
+            return jnp.ones(self.num_features, jnp.float32)
+        return jnp.asarray(feature_mask.astype(np.float32))
+
     def train(self, grad: jax.Array, hess: jax.Array,
               indices: jax.Array, root_count: int,
-              feature_mask: Optional[np.ndarray] = None,
-              root_contiguous: bool = False
+              feature_mask: Optional[np.ndarray] = None
               ) -> Tuple[jax.Array, TreeRecord]:
-        """Grow one tree; returns (new partition indices, TreeRecord).
-        `indices` must be padded so begin+bucket_size never overflows
-        (length n + pow2ceil(n)). Pass root_contiguous=True when `indices`
-        is the identity permutation (no bagging, fresh partition)."""
+        """Grow one tree on an explicit (e.g. bagged) partition; returns
+        (new partition indices, TreeRecord). `indices` must be padded so
+        begin+bucket_size never overflows (length n + pow2ceil(n))."""
         root_padded = max(_pow2ceil(root_count), self.min_pad)
-        key = (root_padded, bool(root_contiguous))
+        key = (root_padded, False)
         fn = self._build_cache.get(key)
         if fn is None:
-            fn = self._make_build_fn(root_padded, bool(root_contiguous))
+            fn = self._make_build_fn(root_padded, False)
             self._build_cache[key] = fn
-        if feature_mask is None:
-            fmask = jnp.ones(self.num_features, jnp.float32)
-        else:
-            fmask = jnp.asarray(feature_mask.astype(np.float32))
         return fn(self.bins_dev, self.bins_T_dev, indices, grad, hess,
-                  jnp.int32(root_count), fmask)
+                  jnp.int32(root_count), self._fmask_arr(feature_mask))
+
+    def train_fresh(self, grad: jax.Array, hess: jax.Array,
+                    feature_mask: Optional[np.ndarray] = None
+                    ) -> Tuple[jax.Array, TreeRecord]:
+        """Grow one tree on the full data with a fresh identity partition
+        (created inside the program — fewer dispatches, contiguous root
+        histogram)."""
+        root_padded = max(_pow2ceil(self.n), self.min_pad)
+        key = (root_padded, True)
+        fn = self._build_cache.get(key)
+        if fn is None:
+            fn = self._make_build_fn(root_padded, True)
+            self._build_cache[key] = fn
+        return fn(self.bins_dev, self.bins_T_dev, grad, hess,
+                  self._fmask_arr(feature_mask))
+
+    def train_iter_fused(self, score: jax.Array, objective, scale: float,
+                         feature_mask: Optional[np.ndarray] = None
+                         ) -> Tuple[jax.Array, jax.Array, TreeRecord]:
+        """ONE device program for a whole boosting iteration (single-class,
+        no bagging): objective gradients -> fused tree build -> partition
+        score update. Per-program launch costs ~100-200ms on a tunneled
+        runtime, so the three stages are traced together; the score buffer
+        is donated through.
+
+        Returns (new_score [K,N], indices, record).
+        """
+        root_padded = max(_pow2ceil(self.n), self.min_pad)
+        key = (root_padded, "iter_fused", id(objective))
+        fn = self._build_cache.get(key)
+        if fn is None:
+            build = self._make_build_fn(root_padded, True)
+
+            def step(score, scale, fmask):
+                gdev, hdev = objective.gradients_impl(score)
+                # nested jitted calls inline into this trace
+                indices, rec = build(self.bins_dev, self.bins_T_dev,
+                                     gdev[0], hdev[0], fmask)
+                new_score = _partition_score_update(
+                    score, jnp.int32(0), rec.leaf_begin, rec.leaf_cnt_part,
+                    rec.leaf_value, indices, jnp.int32(self.n), scale)
+                return new_score, indices, rec
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            self._build_cache[key] = fn
+        return fn(score, jnp.float32(scale), self._fmask_arr(feature_mask))
 
     # ------------------------------------------------------------------
     def record_to_tree(self, rec_host, shrinkage: float = 1.0) -> Tree:
@@ -709,15 +790,26 @@ class DeviceTreeLearner:
         return tree
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _partition_score_update(score, class_id, leaf_begin, leaf_cnt,
+                            leaf_value, indices, count, scale):
+    """One fused program: leaf fill over the partition + key-sort back to
+    row order + score[class_id] += scale * delta."""
+    n = score.shape[1]
+    # leaf slices all live inside [0, n): fill and sort only that prefix
+    fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value, n)
+    delta = unpermute_to_rows(lax.slice(indices, (0,), (n,)), fill, count, n)
+    return score.at[class_id].add(scale * delta)
+
+
 @functools.partial(jax.jit, static_argnames=("padded",))
-def _masked_sums(indices, grad, hess, count, padded: int):
+def _masked_sums(indices, gh, count, padded: int):
     idx = lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
     pos = jnp.arange(padded, dtype=jnp.int32)
     valid = pos < count
     safe = jnp.where(valid, idx, 0)
-    g = jnp.where(valid, grad[safe], 0.0)
-    h = jnp.where(valid, hess[safe], 0.0)
-    return g.sum(), h.sum()
+    s = jnp.sum(jnp.where(valid[:, None], gh[safe], 0.0), axis=0)
+    return s[0], s[1]
 
 
 # ---------------------------------------------------------------------------

@@ -274,6 +274,8 @@ class GBDT:
         if grad is None or hess is None:
             for k in range(self.num_tree_per_iteration):
                 init_scores[k] = self.boost_from_average(k)
+            if self._mega_fused_eligible():
+                return self._train_one_iter_mega(init_scores)
             gdev, hdev = self._gradients()
         else:
             gdev = jnp.asarray(np.asarray(grad, np.float32).reshape(
@@ -340,6 +342,80 @@ class GBDT:
         return t
 
     # ------------------------------------------------------------------
+    def _mega_fused_eligible(self) -> bool:
+        """Whole-iteration single-program path: gradients + tree build +
+        score update traced together (per-program launches cost ~100-200ms
+        on a tunneled runtime). Requires: fused learner on a single device,
+        one tree per iteration, no bagging this iteration, a jit-traceable
+        objective (no host-side gradient composition like lambdarank), and
+        no DART-style score reshaping."""
+        return (self.cfg.tpu_fuse_iteration
+                and self.use_fused
+                and type(self.learner) is DeviceTreeLearner
+                and self.num_tree_per_iteration == 1
+                and self._class_need_train[0]
+                and self.train_data.num_features > 0
+                and not self._will_bag()
+                ) and (
+                type(self.objective).get_gradients
+                is ObjectiveFunction.get_gradients
+                ) and (
+                type(self).get_training_score is GBDT.get_training_score
+                ) and (
+                type(self)._post_bagging_gradients
+                is GBDT._post_bagging_gradients)
+
+    def _will_bag(self) -> bool:
+        cfg = self.cfg
+        need = (cfg.bagging_freq > 0
+                and (cfg.bagging_fraction < 1.0 or self._balanced_bagging))
+        return bool(need)
+
+    def _train_one_iter_mega(self, init_scores) -> bool:
+        """One fused device program per boosting iteration."""
+        cfg = self.cfg
+        fmask = self.learner.feature_mask()
+        new_score, idxs, rec = self.learner.train_iter_fused(
+            self.train_score.score, self.objective, self.shrinkage_rate,
+            fmask)
+        self.train_score.score = new_score
+        lazy = LazyTree(rec, self.shrinkage_rate, init_scores[0],
+                        self.learner, max(cfg.num_leaves - 1, 1))
+        self.models.append(lazy)
+        trav = None
+        for i, su in enumerate(self.valid_scores):
+            if trav is None:
+                trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
+            vb = self._valid_bins_dev[i]
+            su.score = su.score.at[0].set(
+                add_record_score(su.score[0], vb, trav, self._trav_nb,
+                                 self._trav_db, self._trav_mt,
+                                 jnp.float32(self.shrinkage_rate)))
+        self._pending_numsplits.append(rec.num_splits)
+        self.iter += 1
+        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
+            return self._trim_trailing_empty()
+        return False
+
+    def _trim_trailing_empty(self) -> bool:
+        """Deferred empty-tree check shared by the fused paths
+        (gbdt.cpp:436-444 batched)."""
+        ns = [int(x) for x in jax.device_get(self._pending_numsplits)]
+        self._pending_numsplits = []
+        k = self.num_tree_per_iteration
+        empty_trailing = 0
+        for it in range(len(ns) // k - 1, -1, -1):
+            if max(ns[it * k:(it + 1) * k]) == 0:
+                empty_trailing += 1
+            else:
+                break
+        if empty_trailing and len(self.models) > k:
+            drop = min(empty_trailing * k, len(self.models) - k)
+            del self.models[-drop:]
+            self.iter -= drop // k
+            return True
+        return False
+
     def _train_one_iter_fused(self, gdev, hdev, init_scores) -> bool:
         """Fused path: whole-tree device programs, no mid-iteration host
         syncs; empty-tree detection is deferred and batched."""
@@ -347,11 +423,6 @@ class GBDT:
         bagged = self.bag_data_indices is not None
         any_trained = False
         for k in range(self.num_tree_per_iteration):
-            # fresh identity partition per tree: keeps the root histogram
-            # contiguous (no random gather of the full dataset) and makes
-            # the partition-based score update exact
-            idxs, count = self.learner.init_root_partition(
-                self.bag_data_indices, self.bag_data_cnt)
             # fresh column sample per tree, like SerialTreeLearner
             fmask = self.learner.feature_mask()
             if not self._class_need_train[k] \
@@ -362,18 +433,26 @@ class GBDT:
                 self._pending_numsplits.append(0)
                 continue
             any_trained = True
-            idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
-                                           fmask, root_contiguous=not bagged)
+            if not bagged:
+                # fresh identity partition created inside the fused program:
+                # contiguous root histogram, no init-partition dispatch
+                idxs, rec = self.learner.train_fresh(gdev[k], hdev[k], fmask)
+            else:
+                idxs, count = self.learner.init_root_partition(
+                    self.bag_data_indices, self.bag_data_cnt)
+                idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
+                                               fmask)
             lazy = LazyTree(rec, self.shrinkage_rate, init_scores[k],
                             self.learner, max(cfg.num_leaves - 1, 1))
             self.models.append(lazy)
             if not bagged:
                 # partition-based score update: leaf fill + one key-sort back
-                # to row order (no per-level tree traversal)
-                self.train_score.score = self.train_score.score.at[k].set(
+                # to row order (no per-level tree traversal); one fused
+                # program with the score buffer donated
+                self.train_score.score = \
                     self.learner.add_score_from_partition(
-                        self.train_score.score[k], rec, idxs, count,
-                        self.shrinkage_rate))
+                        self.train_score.score, k, rec, idxs,
+                        self.shrinkage_rate)
                 trav = None
             else:
                 # bagged: out-of-bag rows also need scores -> traversal
@@ -404,20 +483,7 @@ class GBDT:
         # trailing all-empty iterations are trimmed like the reference's
         # immediate stop (gbdt.cpp:436-444)
         if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
-            ns = [int(x) for x in jax.device_get(self._pending_numsplits)]
-            self._pending_numsplits = []
-            k = self.num_tree_per_iteration
-            empty_trailing = 0
-            for it in range(len(ns) // k - 1, -1, -1):
-                if max(ns[it * k:(it + 1) * k]) == 0:
-                    empty_trailing += 1
-                else:
-                    break
-            if empty_trailing and len(self.models) > k:
-                drop = min(empty_trailing * k, len(self.models) - k)
-                del self.models[-drop:]
-                self.iter -= drop // k
-                return True
+            return self._trim_trailing_empty()
         return False
 
     def materialized_models(self) -> List[Tree]:

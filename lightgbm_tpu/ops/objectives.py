@@ -58,8 +58,18 @@ class ObjectiveFunction:
             self._weight_np = np.asarray(metadata.weight, np.float32)
             self.weight = jnp.asarray(self._weight_np)
 
-    # grad/hess: [K, N] given scores [K, N]
+    # grad/hess: [K, N] given scores [K, N]. The public entry jits the
+    # per-class `gradients_impl` once per objective instance so the whole
+    # gradient computation is ONE device program, not a chain of eager ops
+    # (each eager dispatch costs a host round-trip on a tunneled TPU).
     def get_gradients(self, scores: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        fn = self.__dict__.get("_jit_gradients")
+        if fn is None:
+            fn = jax.jit(self.gradients_impl)
+            self.__dict__["_jit_gradients"] = fn
+        return fn(scores)
+
+    def gradients_impl(self, scores: jax.Array) -> Tuple[jax.Array, jax.Array]:
         g, h = self._point_grad(scores[0], self.label)
         if self.weight is not None:
             g = g * self.weight
@@ -285,7 +295,7 @@ class RegressionMAPE(_PercentileRenewMixin, ObjectiveFunction):
                                  ).astype(np.float32)
         self._label_weight = jnp.asarray(self._label_weight_np)
 
-    def get_gradients(self, scores):
+    def gradients_impl(self, scores):
         diff = scores[0] - self.label
         g = _sign(diff) * self._label_weight
         h = self._label_weight
@@ -368,7 +378,7 @@ class BinaryLogloss(ObjectiveFunction):
         self._label_weight = jnp.where(jnp.asarray(pos), w_pos, w_neg)
         self.need_train = cnt_pos > 0 and cnt_neg > 0
 
-    def get_gradients(self, scores):
+    def gradients_impl(self, scores):
         sig = self.cfg.sigmoid
         score = scores[0]
         label = self._sign_label
@@ -423,7 +433,7 @@ class MulticlassSoftmax(ObjectiveFunction):
         np.add.at(probs, li, w)
         self._class_init_probs = probs / probs.sum()
 
-    def get_gradients(self, scores):
+    def gradients_impl(self, scores):
         # scores [K, N]
         p = jax.nn.softmax(scores, axis=0)
         onehot = (jnp.arange(self.num_class)[:, None]
@@ -519,7 +529,7 @@ class CrossEntropyLambda(ObjectiveFunction):
         if np.any(self._label_np < 0) or np.any(self._label_np > 1):
             raise ValueError("[xentlambda]: labels must be in [0, 1]")
 
-    def get_gradients(self, scores):
+    def gradients_impl(self, scores):
         """(xentropy_objective.hpp:185-224): weights act as exposure/trials
         under the log(1+exp(score)) link."""
         score = scores[0]

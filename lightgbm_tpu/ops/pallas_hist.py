@@ -1,0 +1,121 @@
+"""Pallas TPU histogram kernel — the `ocl/histogram256.cl` analogue.
+
+The reference GPU learner builds per-leaf gradient/hessian histograms with
+hand-written OpenCL kernels using workgroup-local memory and float atomics
+(`src/treelearner/ocl/histogram256.cl:100-125,350`). TPU has no fast
+scatter-add, so the kernel keeps the histogram accumulator **resident in
+VMEM across the whole row stream** and converts the scatter into per-feature
+one-hot contractions on the MXU:
+
+    for each row-chunk (grid dim, pipelined HBM->VMEM by pallas):
+        for each feature f (static unroll):
+            onehot[c, b] = (bins[c, f] == b)          # VPU compare vs iota
+            hist[f] += onehot^T @ payload[c, {g,h,1}]  # MXU [B,C]x[C,W]
+
+Unlike the XLA einsum formulation (`ops/histogram.py`), the one-hot tile
+never leaves VMEM and the accumulator is written to HBM exactly once, at the
+last grid step. Numerics: the one-hot is exact in bf16; payload rides as
+hi/lo bf16 pairs (two extra columns) so the f32-accumulated result matches
+the reference's single-precision GPU histograms (`gpu_use_dp=0`) or better.
+
+Used via `Config.tpu_use_pallas`; the einsum path stays the fallback (and
+the only path on CPU test meshes, where pallas TPU kernels can't lower).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+try:  # pallas is TPU-only here; import lazily-guarded for CPU test runs
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+NUM_STATS = 3  # grad, hess, count
+
+
+def _hist_kernel(bins_ref, pay_ref, out_ref, *, num_features: int,
+                 max_bin: int, payload_width: int):
+    """One grid step: accumulate a row-chunk into the VMEM-resident
+    histogram. bins_ref [C, F] int32; pay_ref [C, W]; out_ref [F, B, W]."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[...]
+    pay_f32 = pay_ref[...]                      # [C, 3] f32 (g, h, cnt)
+    # hi/lo bf16 split INSIDE the kernel: done outside, XLA's algebraic
+    # simplifier cancels the f32->bf16->f32 round-trip and silently drops
+    # the low parts; Mosaic keeps the conversions explicit
+    p_hi = pay_f32.astype(jnp.bfloat16)
+    p_lo = (pay_f32 - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    pay = jnp.concatenate([p_hi, p_lo], axis=1)  # [C, 6] bf16
+    chunk = bins.shape[0]
+    iota = lax.broadcasted_iota(jnp.int32, (chunk, max_bin), 1)
+    for f in range(num_features):
+        onehot = (bins[:, f][:, None] == iota).astype(jnp.bfloat16)
+        # [B, 2W] = [C, B]^T x [C, 2W] on the MXU, f32 accumulation
+        contrib = lax.dot_general(
+            onehot, pay, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[f, :, :] += contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "chunk"))
+def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
+                     max_bin: int, chunk: int = 1 << 11) -> jax.Array:
+    """hist[F, max_bin, 3] over contiguous (already gathered) rows.
+
+    bins_rows: uint8/int32 [P, F]; gh: f32 [P, 2]; valid: bool [P].
+    Same contract as `histogram_from_gathered_gh`. P is padded to a chunk
+    multiple; masked rows contribute nothing (payload zeroed and bin forced
+    out of range).
+    """
+    p, f = bins_rows.shape
+    bins_i = bins_rows.astype(jnp.int32)
+    g = jnp.where(valid, gh[:, 0], 0.0)
+    h = jnp.where(valid, gh[:, 1], 0.0)
+    cnt = valid.astype(jnp.float32)
+    pay = jnp.stack([g, h, cnt], axis=1)         # f32; hi/lo split in-kernel
+    bins_i = jnp.where(valid[:, None], bins_i, max_bin)  # out-of-range
+    n_chunks = max(1, (p + chunk - 1) // chunk)
+    pad = n_chunks * chunk - p
+    if pad:
+        bins_i = jnp.pad(bins_i, ((0, pad), (0, 0)), constant_values=max_bin)
+        pay = jnp.pad(pay, ((0, pad), (0, 0)))
+
+    w = 2 * NUM_STATS
+    kernel = functools.partial(_hist_kernel, num_features=f, max_bin=max_bin,
+                               payload_width=w)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((chunk, f), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, NUM_STATS), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((f, max_bin, w), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, max_bin, w), jnp.float32),
+    )(bins_i, pay)
+    # fold the lo-parts back into the hi sums
+    return out[..., :NUM_STATS] + out[..., NUM_STATS:]
+
+
+def pallas_available() -> bool:
+    """True when a TPU backend is attached and pallas can lower."""
+    if not HAS_PALLAS:
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon") or \
+            "TPU" in str(jax.devices()[0])
+    except Exception:  # pragma: no cover
+        return False

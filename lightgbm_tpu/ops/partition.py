@@ -49,7 +49,8 @@ def categorical_goes_left(binvals: jax.Array, bitset: jax.Array) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("padded",))
-def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
+def split_partition(indices: jax.Array, bins_col: jax.Array,
+                    begin: jax.Array,
                     count: jax.Array, padded: int, threshold: jax.Array,
                     default_left: jax.Array, missing_type: jax.Array,
                     default_bin: jax.Array, num_bin: jax.Array,
@@ -58,7 +59,8 @@ def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
     """Stable-partition one leaf's slice of the global index array.
 
     indices:  int32 [N_pad] permuted row ids (leaf rows contiguous)
-    bins_col: uint8/int32 [N] the split feature's bin column
+    bins_col: uint8/int32 [N] the split feature's bin column (a contiguous
+        dynamic_slice row of the transposed bins)
     begin/count: dynamic scalars; padded: static slice length >= count
     cat_bitset: uint32[8] (covers 256 bins) — ignored for numerical
 
@@ -73,10 +75,12 @@ def split_partition(indices: jax.Array, bins_col: jax.Array, begin: jax.Array,
                                  default_bin, num_bin)
     gl_cat = categorical_goes_left(b, cat_bitset)
     goes_left = jnp.where(is_categorical, gl_cat, gl_num)
-    # stable 3-key sort: left rows (0), right rows (1), out-of-leaf tail (2)
+    # stable 3-key sort: left rows (0), right rows (1), out-of-leaf tail (2).
+    # The row ids ride through the sort network as a payload operand —
+    # regular compare-exchange data movement instead of the random
+    # idx[argsort(key)] gather (gathers are the expensive op on TPU).
     key = jnp.where(valid, jnp.where(goes_left, 0, 1), 2).astype(jnp.int32)
-    order = jnp.argsort(key, stable=True)
-    new_slice = idx[order]
+    _, new_slice = lax.sort([key, idx], num_keys=1, is_stable=True)
     left_count = jnp.sum((key == 0).astype(jnp.int32))
     new_indices = lax.dynamic_update_slice(indices, new_slice, (begin,))
     return new_indices, left_count
@@ -110,12 +114,17 @@ def unpermute_to_rows(indices: jax.Array, values: jax.Array,
     no-bagging partition); positions beyond `count` get key n+p so they sort
     to the tail. Bagged iterations must use the traversal path instead
     (out-of-bag rows also need scores, reference gbdt.cpp:487-506).
+
+    Only the live prefix [0, n) is sorted: every leaf slice lives inside
+    [0, root_count) and root_count <= n, so the pow2 padding tail never
+    holds data.
     """
-    n_pad = indices.shape[0]
-    pos = jnp.arange(n_pad, dtype=jnp.int32)
-    key = jnp.where(pos < count, indices, n + pos)
-    _, sval = lax.sort([key, values], num_keys=1)
-    return lax.slice(sval, (0,), (n,))
+    head = lax.slice(indices, (0,), (n,))
+    vals = lax.slice(values, (0,), (n,))
+    pos = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(pos < count, head, n + pos)
+    _, sval = lax.sort([key, vals], num_keys=1)
+    return sval
 
 
 @functools.partial(jax.jit, static_argnames=("n", "n_pad"))

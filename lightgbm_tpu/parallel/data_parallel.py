@@ -135,17 +135,36 @@ class DataParallelTreeLearner:
             return fn
         build = self.inner._make_build_fn(self.local_pad, root_contiguous)
         ax = self.axis_name
-
-        def per_shard(bins, bins_T, indices, grad, hess, counts, fmask):
-            return build(bins, bins_T, indices, grad, hess, counts[0], fmask)
-
+        # per-shard partition state (leaf_begin/leaf_cnt_part) stays sharded;
+        # everything else is replicated (identical on every shard)
         rec_specs = TreeRecord(
             num_splits=P(), leaf=P(), feature=P(), threshold_bin=P(),
             default_left=P(), is_cat=P(), cat_bitset=P(), left_output=P(),
             right_output=P(), left_count=P(), right_count=P(), gain=P(),
             internal_value=P(), leaf_value=P(), leaf_count_arr=P(),
-            # per-shard partition state stays sharded
             leaf_begin=P(ax), leaf_cnt_part=P(ax))
+
+        if root_contiguous:
+            mapped = jax.shard_map(
+                build, mesh=self.mesh,
+                in_specs=(P(ax), P(None, ax), P(ax), P(ax), P()),
+                out_specs=(P(ax), rec_specs),
+                check_vma=False)
+
+            def run_fresh(bins, bins_T, grad, hess, fmask):
+                pad = self.nd * self.per_shard - grad.shape[0]
+                if pad:
+                    grad = jnp.pad(grad, (0, pad))
+                    hess = jnp.pad(hess, (0, pad))
+                return mapped(bins, bins_T, grad, hess, fmask)
+
+            fn = jax.jit(run_fresh)
+            self._fn_cache[key] = fn
+            return fn
+
+        def per_shard(bins, bins_T, indices, grad, hess, counts, fmask):
+            return build(bins, bins_T, indices, grad, hess, counts[0], fmask)
+
         mapped = jax.shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(P(ax), P(None, ax), P(ax), P(ax), P(ax), P(ax), P()),
@@ -201,52 +220,58 @@ class DataParallelTreeLearner:
         if fn is not None:
             return fn
         ax = self.axis_name
+        from jax import lax
+
         from ..ops.partition import leaf_value_fill, unpermute_to_rows
         local_len = self.local_idx_len
         per = self.per_shard
+        n = self.n
 
         def per_shard(score, leaf_begin, leaf_cnt, leaf_value, indices,
-                      counts, scale):
-            fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value,
-                                   local_len)
-            delta = unpermute_to_rows(indices, fill, counts[0], per)
+                      scale):
+            s = lax.axis_index(ax)
+            cnt = jnp.clip(n - s * per, 0, per).astype(jnp.int32)
+            fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value, per)
+            delta = unpermute_to_rows(indices[:per], fill, cnt, per)
             return score + scale * delta
 
         mapped = jax.shard_map(
             per_shard, mesh=self.mesh,
-            in_specs=(P(ax), P(ax), P(ax), P(), P(ax), P(ax), P()),
+            in_specs=(P(ax), P(ax), P(ax), P(), P(ax), P()),
             out_specs=P(ax), check_vma=False)
 
-        def run(score_row, leaf_begin, leaf_cnt, leaf_value, indices,
-                counts, scale):
+        def run(score_row, leaf_begin, leaf_cnt, leaf_value, indices, scale):
             pad = self.nd * per - score_row.shape[0]
             padded = jnp.pad(score_row, (0, pad)) if pad else score_row
             out = mapped(padded, leaf_begin, leaf_cnt, leaf_value, indices,
-                         counts, scale)
+                         scale)
             return out[:score_row.shape[0]] if pad else out
 
         fn = jax.jit(run)
         self._fn_cache["pscore"] = fn
         return fn
 
-    def add_score_from_partition(self, score_row: jax.Array,
+    def add_score_from_partition(self, score: jax.Array, class_id: int,
                                  record: TreeRecord, indices: jax.Array,
-                                 counts, scale: float) -> jax.Array:
+                                 scale: float) -> jax.Array:
         """Partition-based score update, per shard: leaf fill over the local
         partition + one key-sort back to the shard's row-block order."""
-        return self._partition_score_fn()(
-            score_row, record.leaf_begin, record.leaf_cnt_part,
-            record.leaf_value, indices, counts, jnp.float32(scale))
+        row = self._partition_score_fn()(
+            score[class_id], record.leaf_begin, record.leaf_cnt_part,
+            record.leaf_value, indices, jnp.float32(scale))
+        return score.at[class_id].set(row)
 
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array, indices: jax.Array,
-              counts: jax.Array, feature_mask: Optional[np.ndarray] = None,
-              root_contiguous: bool = False
+              counts: jax.Array, feature_mask: Optional[np.ndarray] = None
               ) -> Tuple[jax.Array, TreeRecord]:
-        if feature_mask is None:
-            fmask = jnp.ones(self.inner.num_features, jnp.float32)
-        else:
-            fmask = jnp.asarray(feature_mask.astype(np.float32))
-        fn = self._sharded_train_fn(bool(root_contiguous))
+        fn = self._sharded_train_fn(False)
         return fn(self.bins_sharded, self.bins_T_sharded, indices, grad,
-                  hess, counts, fmask)
+                  hess, counts, self.inner._fmask_arr(feature_mask))
+
+    def train_fresh(self, grad: jax.Array, hess: jax.Array,
+                    feature_mask: Optional[np.ndarray] = None
+                    ) -> Tuple[jax.Array, TreeRecord]:
+        fn = self._sharded_train_fn(True)
+        return fn(self.bins_sharded, self.bins_T_sharded, grad, hess,
+                  self.inner._fmask_arr(feature_mask))

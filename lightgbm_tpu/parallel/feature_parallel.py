@@ -81,9 +81,10 @@ class FeatureParallelTreeLearner:
             return fn
         build = self.inner._make_build_fn(root_padded, root_contiguous)
         rec_specs = TreeRecord(*([P()] * len(TreeRecord._fields)))
+        n_in = 5 if root_contiguous else 7
         mapped = jax.shard_map(
             build, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P()),
+            in_specs=tuple([P()] * n_in),
             out_specs=(P(), rec_specs),
             check_vma=False)
         fn = jax.jit(mapped)
@@ -95,16 +96,21 @@ class FeatureParallelTreeLearner:
 
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array, indices: jax.Array,
-              root_count: int, feature_mask: Optional[np.ndarray] = None,
-              root_contiguous: bool = False
+              root_count: int, feature_mask: Optional[np.ndarray] = None
               ) -> Tuple[jax.Array, TreeRecord]:
         root_padded = max(_pow2ceil(int(root_count)), self.inner.min_pad)
         if feature_mask is None:
             feature_mask = self.inner.feature_mask()
-        if feature_mask is None:
-            fmask = jnp.ones(self.inner.num_features, jnp.float32)
-        else:
-            fmask = jnp.asarray(feature_mask.astype(np.float32))
-        fn = self._sharded_train_fn(root_padded, bool(root_contiguous))
+        fn = self._sharded_train_fn(root_padded, False)
         return fn(self.bins_repl, self.inner.bins_T_dev, indices, grad, hess,
-                  jnp.int32(root_count), fmask)
+                  jnp.int32(root_count), self.inner._fmask_arr(feature_mask))
+
+    def train_fresh(self, grad: jax.Array, hess: jax.Array,
+                    feature_mask: Optional[np.ndarray] = None
+                    ) -> Tuple[jax.Array, TreeRecord]:
+        root_padded = max(_pow2ceil(self.n), self.inner.min_pad)
+        if feature_mask is None:
+            feature_mask = self.inner.feature_mask()
+        fn = self._sharded_train_fn(root_padded, True)
+        return fn(self.bins_repl, self.inner.bins_T_dev, grad, hess,
+                  self.inner._fmask_arr(feature_mask))

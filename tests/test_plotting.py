@@ -1,0 +1,59 @@
+"""Plotting API tests (reference tests/python_package_test/test_plotting.py)."""
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 10)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": ["binary_logloss", "auc"]}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=10,
+                    valid_sets=[ds], valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)],
+                    verbose_eval=False)
+    bst._evals = evals
+    return bst
+
+
+def test_plot_importance(booster):
+    ax = lgb.plot_importance(booster)
+    assert ax is not None
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(booster, importance_type="gain",
+                              max_num_features=3)
+    assert len(ax2.patches) <= 3
+
+
+def test_plot_metric(booster):
+    ax = lgb.plot_metric(booster._evals, metric="auc")
+    assert ax is not None
+    with pytest.raises(ValueError):
+        lgb.plot_metric(booster._evals)  # two metrics -> must pick one
+
+
+def test_plot_split_value_histogram(booster):
+    imp = booster.feature_importance()
+    feat = int(np.argmax(imp))
+    ax = lgb.plot_split_value_histogram(booster, feat)
+    assert ax is not None
+    hist, edges = booster.get_split_value_histogram(feat)
+    assert hist.sum() == imp[feat]
+
+
+def test_create_tree_digraph(booster):
+    g = lgb.create_tree_digraph(booster, tree_index=0,
+                                show_info=["split_gain", "leaf_count"])
+    src = g.source
+    assert "split0" in src and "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(booster, tree_index=10**6)
