@@ -72,7 +72,7 @@ def _hist_kernel(bins_ref, pay_ref, out_ref, *, num_features: int,
 @functools.partial(jax.jit,
                    static_argnames=("max_bin", "chunk"))
 def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
-                     max_bin: int, chunk: int = 1 << 11) -> jax.Array:
+                     max_bin: int, chunk: int = 1 << 9) -> jax.Array:
     """hist[F, max_bin, 3] over contiguous (already gathered) rows.
 
     bins_rows: uint8/int32 [P, F]; gh: f32 [P, 2]; valid: bool [P].
@@ -86,15 +86,18 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
     h = jnp.where(valid, gh[:, 1], 0.0)
     cnt = valid.astype(jnp.float32)
     pay = jnp.stack([g, h, cnt], axis=1)         # f32; hi/lo split in-kernel
-    bins_i = jnp.where(valid[:, None], bins_i, max_bin)  # out-of-range
+    # bin axis padded to a 128-lane multiple: unaligned one-hot tiles force
+    # awkward VMEM layouts (scoped-vmem OOM at max_bin=255)
+    b_pad = max(128, ((max_bin + 127) // 128) * 128)
+    bins_i = jnp.where(valid[:, None], bins_i, b_pad)  # mask -> out-of-range
     n_chunks = max(1, (p + chunk - 1) // chunk)
     pad = n_chunks * chunk - p
     if pad:
-        bins_i = jnp.pad(bins_i, ((0, pad), (0, 0)), constant_values=max_bin)
+        bins_i = jnp.pad(bins_i, ((0, pad), (0, 0)), constant_values=b_pad)
         pay = jnp.pad(pay, ((0, pad), (0, 0)))
 
     w = 2 * NUM_STATS
-    kernel = functools.partial(_hist_kernel, num_features=f, max_bin=max_bin,
+    kernel = functools.partial(_hist_kernel, num_features=f, max_bin=b_pad,
                                payload_width=w)
     out = pl.pallas_call(
         kernel,
@@ -103,11 +106,11 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
             pl.BlockSpec((chunk, f), lambda i: (i, 0)),
             pl.BlockSpec((chunk, NUM_STATS), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((f, max_bin, w), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, max_bin, w), jnp.float32),
+        out_specs=pl.BlockSpec((f, b_pad, w), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, b_pad, w), jnp.float32),
     )(bins_i, pay)
-    # fold the lo-parts back into the hi sums
-    return out[..., :NUM_STATS] + out[..., NUM_STATS:]
+    # fold the lo-parts back into the hi sums; drop the bin padding
+    return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
 
 
 def pallas_available() -> bool:
