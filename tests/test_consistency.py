@@ -1,0 +1,155 @@
+"""Cross-layer consistency: Python `lgb.train` vs the CLI on the reference
+`examples/` configs (reference tests/python_package_test/test_consistency.py
+— FileLoader reads each example's train.conf, trains via the Python API, and
+asserts agreement with CLI-produced predictions, `test_consistency.py:12-46`).
+
+Here both layers are this framework's own (the CLI wraps the same engine),
+so the assertion pins the config-file parsing, text loader, sidecar files
+(.query / .weight), CLI task dispatch, and model text round-trip to the
+in-memory Python path bit-for-bit-ish.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application, read_config_file
+from lightgbm_tpu.io.parser import create_parser, parse_dense
+
+REF = "/root/reference/examples"
+HAS_REF = os.path.isdir(REF)
+pytestmark = pytest.mark.skipif(not HAS_REF, reason="reference examples "
+                                "not mounted")
+
+
+class FileLoader:
+    """reference test_consistency.py FileLoader (:12-24)."""
+
+    def __init__(self, directory: str, prefix: str):
+        self.directory = os.path.join(REF, directory)
+        self.prefix = prefix
+        self.params = read_config_file(
+            os.path.join(self.directory, "train.conf"))
+        self.params["verbosity"] = "-1"
+        for k in ("data", "valid_data", "output_model",
+                  # iteration-count aliases would override the per-test
+                  # num_round (num_trees=100 lives in every train.conf)
+                  "num_trees", "num_iterations", "num_round", "num_rounds",
+                  # the python side trains without a valid set
+                  "early_stopping_round", "early_stopping_rounds",
+                  "early_stopping"):
+            self.params.pop(k, None)
+
+    def path(self, suffix: str) -> str:
+        return os.path.join(self.directory, self.prefix + suffix)
+
+    def load_dense(self, suffix: str):
+        with open(self.path(suffix)) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        p = create_parser(lines, label_idx=0)
+        y, X = parse_dense(lines, p)
+        return y, X
+
+    def load_field(self, suffix: str):
+        fp = self.path(suffix)
+        if not os.path.isfile(fp):
+            return None
+        return np.loadtxt(fp)
+
+
+def _train_python(loader: FileLoader, num_round: int, group=False):
+    y, X = loader.load_dense(".train")
+    params = dict(loader.params)
+    params["num_iterations"] = str(num_round)
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    if group:
+        q = loader.load_field(".train.query")
+        ds.set_group(q.astype(np.int64))
+    w = loader.load_field(".train.weight")
+    if w is not None:
+        ds.set_weight(w)
+    init = loader.load_field(".train.init")
+    if init is not None:
+        ds.set_init_score(init)
+    bst = lgb.train(params, ds, num_boost_round=num_round)
+    return bst
+
+
+def _train_cli(loader: FileLoader, num_round: int, tmp_path):
+    model = tmp_path / "model.txt"
+    out = tmp_path / "pred.txt"
+    Application([
+        f"config={os.path.join(loader.directory, 'train.conf')}",
+        f"data={loader.path('.train')}",
+        f"valid_data={loader.path('.test')}",
+        f"num_trees={num_round}", f"output_model={model}",
+        "verbosity=-1", "metric_freq=100000",
+    ]).run()
+    Application([
+        "task=predict", f"data={loader.path('.test')}",
+        f"input_model={model}", f"output_result={out}",
+    ]).run()
+    return np.loadtxt(str(out))
+
+
+def _check(loader: FileLoader, num_round: int, tmp_path, group=False,
+           raw_score=False):
+    bst = _train_python(loader, num_round, group=group)
+    yt, Xt = loader.load_dense(".test")
+    py_pred = bst.predict(Xt, raw_score=raw_score)
+    cli_pred = _train_cli(loader, num_round, tmp_path)
+    np.testing.assert_allclose(py_pred.reshape(cli_pred.shape), cli_pred,
+                               rtol=1e-5, atol=1e-6)
+    return bst, py_pred, yt
+
+
+def test_binary(tmp_path):
+    loader = FileLoader("binary_classification", "binary")
+    bst, pred, y = _check(loader, 10, tmp_path)
+    # quality floor (reference asserts metric thresholds the same way)
+    pos, neg = pred[y > 0], pred[y <= 0]
+    auc = (pos[:, None] > neg[None, :]).mean()
+    assert auc > 0.75
+
+
+def test_regression(tmp_path):
+    loader = FileLoader("regression", "regression")
+    bst, pred, y = _check(loader, 10, tmp_path)
+    # the example trains from .init scores which predictions exclude
+    # (reference semantics: init_score is training-only)
+    init = loader.load_field(".test.init")
+    full = pred + (init if init is not None else 0.0)
+    # loose sanity floor: 10 rounds at lr=0.05 has barely started fitting
+    assert np.mean((full - y) ** 2) < 1.5 * np.var(y)
+
+
+def test_multiclass(tmp_path):
+    loader = FileLoader("multiclass_classification", "multiclass")
+    bst, pred, y = _check(loader, 5, tmp_path)
+    acc = (np.argmax(pred.reshape(len(y), -1), axis=1) == y).mean()
+    assert acc > 0.3  # 5 classes, 5 rounds: well above the 0.2 chance floor
+
+
+def test_lambdarank(tmp_path):
+    loader = FileLoader("lambdarank", "rank")
+    bst = _train_python(loader, 5, group=True)
+    yt, Xt = loader.load_dense(".test")
+    py_pred = bst.predict(Xt, raw_score=True)
+    model = tmp_path / "model.txt"
+    out = tmp_path / "pred.txt"
+    Application([
+        f"config={os.path.join(loader.directory, 'train.conf')}",
+        f"data={loader.path('.train')}",
+        f"valid_data={loader.path('.test')}",
+        "num_trees=5", f"output_model={model}",
+        "verbosity=-1", "metric_freq=100000",
+    ]).run()
+    Application([
+        "task=predict", f"data={loader.path('.test')}",
+        f"input_model={model}", f"output_result={out}",
+    ]).run()
+    cli_pred = np.loadtxt(str(out))
+    np.testing.assert_allclose(py_pred, cli_pred, rtol=1e-5, atol=1e-6)
