@@ -95,19 +95,34 @@ class DatasetLoader:
         `dataset_loader.cpp:62-140`).
         """
         cfg = self.config
-        header_line, lines = self._read_text(filename)
         all_names = None
-        sep_guess = None
-        if header_line is not None:
-            for sep in ("\t", ",", " "):
-                if sep in header_line:
-                    sep_guess = sep
-                    break
-            all_names = ([s.strip() for s in header_line.split(sep_guess)]
-                         if sep_guess else [header_line.strip()])
-        label_idx = self._resolve_label_idx(all_names)
-        parser = create_parser(lines[:32], label_idx)
-        labels, feats = parse_dense(lines, parser)
+        labels = feats = None
+        if not cfg.header:
+            # headerless files take the native C++ OpenMP parser when the
+            # library is available (reference keeps this whole path in C++:
+            # TextReader + Parser + ExtractFeaturesFromMemory); header /
+            # name-resolution files go through the Python path below
+            from ..native import parse_file as native_parse
+            label_idx = self._resolve_label_idx(None)
+            if not os.path.isfile(filename):
+                raise FileNotFoundError(f"data file {filename} not found")
+            native = native_parse(filename, label_idx)
+            if native is not None:
+                labels, feats, _fmt = native
+        if labels is None:
+            header_line, lines = self._read_text(filename)
+            if header_line is not None:
+                sep_guess = None
+                for sep in ("\t", ",", " "):
+                    if sep in header_line:
+                        sep_guess = sep
+                        break
+                all_names = ([s.strip()
+                              for s in header_line.split(sep_guess)]
+                             if sep_guess else [header_line.strip()])
+            label_idx = self._resolve_label_idx(all_names)
+            parser = create_parser(lines[:32], label_idx)
+            labels, feats = parse_dense(lines, parser)
 
         feat_names = None
         if all_names is not None:

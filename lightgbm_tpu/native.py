@@ -1,0 +1,111 @@
+"""ctypes binding for the native C++ helpers (src/native/).
+
+The reference keeps its whole ingest pipeline in C++ (TextReader /
+Parser / DatasetLoader with OpenMP); the Python package is a thin ctypes
+wrapper over `lib_lightgbm.so` (python-package/lightgbm/basic.py:25-36).
+This module is the same seam for the tpu build: `liblgbt_native.so` is
+loaded via ctypes, built lazily from source with the system toolchain when
+missing, and every caller has a pure-Python fallback, so the package works
+without a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "native")
+_LIB_NAME = "liblgbt_native.so"
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+FMT_CSV, FMT_TSV, FMT_LIBSVM = 0, 1, 2
+_FMT_NAMES = {FMT_CSV: "csv", FMT_TSV: "tsv", FMT_LIBSVM: "libsvm"}
+
+
+def _build() -> Optional[str]:
+    path = os.path.join(_SRC_DIR, _LIB_NAME)
+    if os.path.isfile(path):
+        return path
+    src = os.path.join(_SRC_DIR, "text_parser.cpp")
+    if not os.path.isfile(src):
+        return None
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return path if os.path.isfile(path) else None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.lgbt_scan.restype = ctypes.c_int32
+    lib.lgbt_scan.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)]
+    lib.lgbt_parse.restype = ctypes.c_int32
+    lib.lgbt_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+    lib.lgbt_num_threads.restype = ctypes.c_int32
+    lib.lgbt_num_threads.argtypes = []
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def parse_file(path: str, label_idx: int = 0
+               ) -> Optional[Tuple[np.ndarray, np.ndarray, str]]:
+    """Parse a CSV/TSV/LibSVM data file with the native OpenMP parser.
+
+    Returns (labels[f64 N], features[f64 N x F], format_name), or None when
+    the native library is unavailable (caller falls back to the Python
+    parser). Matches `ops.parser.parse_dense` semantics: NA tokens -> NaN,
+    absent libsvm entries -> 0.0.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    fmt = ctypes.c_int32()
+    rc = lib.lgbt_scan(path.encode(), ctypes.byref(rows), ctypes.byref(cols),
+                       ctypes.byref(fmt))
+    if rc != 0:
+        raise FileNotFoundError(f"data file {path} not found")
+    n = rows.value
+    if fmt.value == FMT_LIBSVM:
+        f = cols.value
+        eff_label = -1
+    else:
+        f = cols.value - (1 if label_idx >= 0 else 0)
+        eff_label = label_idx
+    f = max(f, 0)
+    labels = np.zeros(n, np.float64)
+    feats = np.zeros((n, f), np.float64)
+    rc = lib.lgbt_parse(
+        path.encode(), fmt.value, eff_label, f,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        feats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if rc != 0:
+        raise IOError(f"native parse of {path} failed")
+    return labels, feats, _FMT_NAMES[fmt.value]

@@ -1,0 +1,90 @@
+"""Native C++ parser tests: agreement with the Python parser on every
+format + the loader integration (reference's native ingest path:
+TextReader/Parser, utils/text_reader.h + src/io/parser.cpp)."""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.parser import create_parser, parse_dense
+from lightgbm_tpu.native import native_available, parse_file
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native library unavailable")
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def _py_parse(text, label_idx=0):
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    p = create_parser(lines, label_idx)
+    return parse_dense(lines, p)
+
+
+@pytest.mark.parametrize("sep,name", [("\t", "tsv"), (",", "csv")])
+def test_dense_matches_python(tmp_path, sep, name):
+    rng = np.random.RandomState(0)
+    rows = []
+    for r in range(200):
+        vals = [str(rng.randint(0, 2))] + [f"{v:.6g}"
+                                           for v in rng.randn(12)]
+        rows.append(sep.join(vals))
+    text = "\n".join(rows) + "\n"
+    path = _write(tmp_path, f"data.{name}", text)
+    y_n, X_n, fmt = parse_file(path, label_idx=0)
+    assert fmt == name
+    y_p, X_p = _py_parse(text)
+    np.testing.assert_allclose(y_n, y_p)
+    np.testing.assert_allclose(X_n, X_p)
+
+
+def test_na_tokens(tmp_path):
+    text = "1,na,2.5\n0,1.5,NaN\n1,,3.0\n"
+    path = _write(tmp_path, "na.csv", text)
+    y, X, fmt = parse_file(path, 0)
+    assert fmt == "csv"
+    assert np.isnan(X[0, 0]) and np.isnan(X[1, 1]) and np.isnan(X[2, 0])
+    np.testing.assert_allclose(y, [1, 0, 1])
+
+
+def test_libsvm(tmp_path):
+    text = "1 0:0.5 2:1.5\n0 1:2.0\n1 4:-3.25\n"
+    path = _write(tmp_path, "data.svm", text)
+    y, X, fmt = parse_file(path, 0)
+    assert fmt == "libsvm"
+    y_p, X_p = _py_parse(text)
+    assert X.shape == X_p.shape == (3, 5)
+    np.testing.assert_allclose(X, X_p)
+    np.testing.assert_allclose(y, y_p)
+
+
+def test_reference_binary_matches_python():
+    ref = "/root/reference/examples/binary_classification/binary.train"
+    if not os.path.isfile(ref):
+        pytest.skip("reference examples not mounted")
+    y_n, X_n, fmt = parse_file(ref, 0)
+    with open(ref) as f:
+        text = f.read()
+    y_p, X_p = _py_parse(text)
+    assert fmt == "tsv"
+    np.testing.assert_allclose(y_n, y_p)
+    np.testing.assert_allclose(X_n, X_p)
+
+
+def test_loader_uses_native(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.loader import DatasetLoader
+    rng = np.random.RandomState(1)
+    rows = ["\t".join([str(rng.randint(0, 2))]
+                      + [f"{v:.6g}" for v in rng.randn(5)])
+            for _ in range(100)]
+    path = _write(tmp_path, "t.tsv", "\n".join(rows) + "\n")
+    cfg = Config.from_params({"verbosity": -1})
+    loader = DatasetLoader(cfg)
+    labels, feats, extras = loader.parse_file(path)
+    assert feats.shape == (100, 5)
+    assert set(np.unique(labels)) <= {0.0, 1.0}
