@@ -25,7 +25,27 @@ from .models.model_text import (dump_model_json, load_model_from_string,
 from .models.tree import Tree
 from .ops.metrics import create_metrics, metric_names
 from .ops.objectives import create_objective
-from .ops.predict import predict_raw_values
+from .ops.predict import flatten_forest, predict_raw_values
+
+
+def _native_predict(trees, X, num_class: int, pred_leaf: bool = False,
+                    flat=None):
+    """Batch predict through the native OpenMP predictor
+    (src/native/predictor.cpp); None -> caller uses the NumPy walk."""
+    from . import native
+    if not trees or not native.native_available():
+        return None
+    if flat is None:
+        flat = flatten_forest(trees, num_class)
+    if X.shape[1] <= int(flat["feat"].max(initial=-1)):
+        raise ValueError(
+            f"data has {X.shape[1]} features but the model was trained "
+            f"with at least {int(flat['feat'].max()) + 1}")
+    out = native.predict_forest(np.asarray(X, np.float64), flat,
+                                num_class, pred_leaf)
+    if out is None or pred_leaf:
+        return out
+    return out.reshape(len(X), num_class) if out.ndim == 1 else out
 
 
 class LightGBMError(Exception):
@@ -197,6 +217,8 @@ class Booster:
         self.params = dict(params or {})
         self.best_iteration = -1
         self.best_score: Dict = {}
+        self._flat_cache: Optional[tuple] = None
+        self._model_gen = 0
         self._train_set = train_set
         self._gbdt: Optional[GBDT] = None
         self._loaded: Optional[Dict] = None
@@ -265,11 +287,14 @@ class Booster:
                 grad, hess = fobj(scores.T, self._train_set)
             grad = np.asarray(grad, np.float32).reshape(k, -1)
             hess = np.asarray(hess, np.float32).reshape(k, -1)
+            self._model_gen += 1
             return self._gbdt.train_one_iter(grad, hess)
+        self._model_gen += 1
         return self._gbdt.train_one_iter()
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        self._model_gen += 1
         return self
 
     # ------------------------------------------------------------------
@@ -325,6 +350,7 @@ class Booster:
                             + (1.0 - decay_rate) * out * tree.shrinkage)
                 tree.leaf_value[:nl] = new_vals
                 scores[tid] += new_vals[lp]
+        self._model_gen += 1
         return self
 
     @property
@@ -370,16 +396,32 @@ class Booster:
         trees = self.trees
         if num_iteration and num_iteration > 0:
             trees = trees[:num_iteration * k]
+        # flattened-forest cache for the native predictor (rebuilt when the
+        # model mutates or the tree horizon changes)
+        flat = None
+        from .native import native_available
+        if trees and native_available():
+            key = (len(trees), k, self._model_gen)
+            if self._flat_cache is not None and self._flat_cache[0] == key:
+                flat = self._flat_cache[1]
+            else:
+                flat = flatten_forest(trees, k)
+                self._flat_cache = (key, flat)
         if pred_leaf:
+            out = _native_predict(trees, X, k, pred_leaf=True, flat=flat)
+            if out is not None:
+                return out.astype(np.int32)
             return predict_raw_values(trees, X, leaf_index=True)
         if pred_contrib:
             from .ops.shap import predict_contrib
             return predict_contrib(trees, X, k)
         n = len(X)
-        raw = np.zeros((n, k), np.float64)
-        for cls in range(k):
-            cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
-            raw[:, cls] = predict_raw_values(cls_trees, X)
+        raw = _native_predict(trees, X, k, flat=flat)
+        if raw is None:
+            raw = np.zeros((n, k), np.float64)
+            for cls in range(k):
+                cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
+                raw[:, cls] = predict_raw_values(cls_trees, X)
         if self._is_average_output():
             raw = raw / max(1, len(trees) // k)
         objective = self._objective_for_predict()

@@ -154,7 +154,9 @@ class Dataset:
         `LoadFromFileAlignWithOtherDataset`, `dataset_loader.cpp:224`).
         """
         cfg = config or Config()
-        data = np.asarray(data, dtype=np.float64)
+        data = np.asarray(data)
+        if data.dtype not in (np.float32, np.float64):
+            data = data.astype(np.float64)
         if data.ndim != 2:
             raise ValueError("data must be 2-D")
         n, f = data.shape
@@ -198,7 +200,7 @@ class Dataset:
                 sample = data
             self.mappers = []
             for j in range(f):
-                col = sample[:, j]
+                col = np.asarray(sample[:, j], dtype=np.float64)
                 # keep only non-zero entries; zeros are implied by count
                 nonzero = col[~((col >= -1e-35) & (col <= 1e-35))]
                 m = BinMapper()
@@ -238,10 +240,12 @@ class Dataset:
                     "(device bitset limit)")
         max_nb = max((self.mappers[j].num_bin for j in used), default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
-        bins = np.empty((n, len(used)), dtype=dtype)
-        for col_idx, j in enumerate(used):
-            bins[:, col_idx] = self.mappers[j].values_to_bins(
-                data[:, j]).astype(dtype)
+        bins = self._native_bin_matrix(data, used, dtype)
+        if bins is None:
+            bins = np.empty((n, len(used)), dtype=dtype)
+            for col_idx, j in enumerate(used):
+                bins[:, col_idx] = self.mappers[j].values_to_bins(
+                    np.asarray(data[:, j], dtype=np.float64)).astype(dtype)
         self.bins = bins
 
         if label is not None:
@@ -250,6 +254,47 @@ class Dataset:
         self.metadata.set_group(group)
         self.metadata.set_init_score(init_score)
         return self
+
+    # ------------------------------------------------------------------
+    def _native_bin_matrix(self, data: np.ndarray, used: np.ndarray,
+                           dtype) -> Optional[np.ndarray]:
+        """Full-matrix ingest through the native OpenMP binner
+        (src/native/binning.cpp lgbt_bin_matrix); None -> Python loop."""
+        from ..native import bin_matrix, native_available
+        if not native_available() or len(used) == 0:
+            return None
+        ms = [self.mappers[j] for j in used]
+        bin_type = np.asarray([_BINTYPE_CODE[m.bin_type] for m in ms],
+                              np.int32)
+        missing = np.asarray([_MISSING_CODE[m.missing_type] for m in ms],
+                             np.int32)
+        num_bin = np.asarray([m.num_bin for m in ms], np.int32)
+        bounds_list = [m.bin_upper_bound if m.bin_type == BIN_NUMERICAL
+                       else np.zeros(0) for m in ms]
+        bounds_off = np.concatenate(
+            [[0], np.cumsum([len(b) for b in bounds_list])]).astype(np.int64)
+        bounds = (np.concatenate(bounds_list) if bounds_list
+                  else np.zeros(0))
+        cats_list, cat_bins_list = [], []
+        for m in ms:
+            if m.bin_type == BIN_CATEGORICAL and m.categorical_2_bin:
+                ck = np.fromiter(m.categorical_2_bin.keys(), np.int64)
+                cv = np.fromiter(m.categorical_2_bin.values(), np.int64)
+                order = np.argsort(ck)
+                cats_list.append(ck[order])
+                cat_bins_list.append(cv[order].astype(np.int32))
+            else:
+                cats_list.append(np.zeros(0, np.int64))
+                cat_bins_list.append(np.zeros(0, np.int32))
+        cats_off = np.concatenate(
+            [[0], np.cumsum([len(c) for c in cats_list])]).astype(np.int64)
+        cats = (np.concatenate(cats_list) if cats_list
+                else np.zeros(0, np.int64))
+        cat_bins = (np.concatenate(cat_bins_list) if cat_bins_list
+                    else np.zeros(0, np.int32))
+        return bin_matrix(data, np.asarray(used, np.int32), bin_type,
+                          missing, num_bin, bounds, bounds_off,
+                          cats.astype(np.int64), cat_bins, cats_off, dtype)
 
     # ------------------------------------------------------------------
     def subset(self, row_indices: np.ndarray) -> "Dataset":

@@ -29,16 +29,15 @@ _FMT_NAMES = {FMT_CSV: "csv", FMT_TSV: "tsv", FMT_LIBSVM: "libsvm"}
 
 def _build() -> Optional[str]:
     path = os.path.join(_SRC_DIR, _LIB_NAME)
-    if os.path.isfile(path):
-        return path
     src = os.path.join(_SRC_DIR, "text_parser.cpp")
     if not os.path.isfile(src):
-        return None
+        return path if os.path.isfile(path) else None
     try:
+        # make is a no-op when the .so is newer than every source
         subprocess.run(["make", "-C", _SRC_DIR], check=True,
                        capture_output=True, timeout=120)
     except Exception:
-        return None
+        pass  # a prebuilt .so (if any) still works
     return path if os.path.isfile(path) else None
 
 
@@ -65,6 +64,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
     lib.lgbt_num_threads.restype = ctypes.c_int32
     lib.lgbt_num_threads.argtypes = []
+    c = ctypes
+    p64, pf64, p32, p8, pu32 = (c.POINTER(c.c_int64), c.POINTER(c.c_double),
+                                c.POINTER(c.c_int32), c.POINTER(c.c_int8),
+                                c.POINTER(c.c_uint32))
+    try:  # a stale prebuilt .so may predate these symbols
+        lib.lgbt_find_bin_numerical.restype = c.c_int32
+        lib.lgbt_find_bin_numerical.argtypes = [
+            pf64, c.c_int64, c.c_int64, c.c_int32, c.c_int32, pf64]
+        lib.lgbt_bin_matrix.restype = c.c_int32
+        lib.lgbt_bin_matrix.argtypes = [
+            c.c_void_p, c.c_int32, c.c_int64, c.c_int64, p32, c.c_int64, p32,
+            p32, p32, pf64, p64, p64, p32, p64, c.c_int32, c.c_void_p]
+        lib.lgbt_predict.restype = c.c_int32
+        lib.lgbt_predict.argtypes = [
+            pf64, c.c_int64, c.c_int64, c.c_int32, p64, p64, p32, p32, p32,
+            pf64, p8, pf64, p64, p32, p64, pu32, p32, p32, c.c_int32,
+            c.c_int32, pf64]
+    except AttributeError:
+        pass
     _lib = lib
     return _lib
 
@@ -109,3 +127,103 @@ def parse_file(path: str, label_idx: int = 0
     if rc != 0:
         raise IOError(f"native parse of {path} failed")
     return labels, feats, _FMT_NAMES[fmt.value]
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def find_bin_numerical(values: np.ndarray, total_sample_cnt: int,
+                       max_bin: int, min_data_in_bin: int
+                       ) -> Optional[np.ndarray]:
+    """Numerical bin-boundary search in C++ (binning.cpp); None when the
+    native library is unavailable or the search degenerates (caller falls
+    back to the Python implementation)."""
+    lib = get_lib()
+    if lib is None or max_bin < 2 or not hasattr(lib, "lgbt_find_bin_numerical"):
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    out = np.empty(max_bin + 1, np.float64)
+    n = lib.lgbt_find_bin_numerical(
+        _ptr(values, ctypes.c_double), len(values), int(total_sample_cnt),
+        int(max_bin), int(min_data_in_bin), _ptr(out, ctypes.c_double))
+    if n < 0:
+        return None
+    return out[:n].copy()
+
+
+def bin_matrix(data: np.ndarray, col_idx: np.ndarray, bin_type: np.ndarray,
+               missing: np.ndarray, num_bin: np.ndarray,
+               bounds: np.ndarray, bounds_off: np.ndarray,
+               cats: np.ndarray, cat_bins: np.ndarray, cats_off: np.ndarray,
+               out_dtype) -> Optional[np.ndarray]:
+    """Full-matrix value->bin ingest in C++ with OpenMP over rows
+    (binning.cpp lgbt_bin_matrix); None when unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lgbt_bin_matrix"):
+        return None
+    if data.dtype == np.float64:
+        dtype_code = 0
+    elif data.dtype == np.float32:
+        dtype_code = 1
+    else:
+        return None
+    data = np.ascontiguousarray(data)
+    n, f_total = data.shape
+    f_used = len(col_idx)
+    out = np.empty((n, f_used), dtype=out_dtype)
+    rc = lib.lgbt_bin_matrix(
+        data.ctypes.data_as(ctypes.c_void_p), dtype_code, n, f_total,
+        _ptr(np.ascontiguousarray(col_idx, np.int32), ctypes.c_int32),
+        f_used,
+        _ptr(np.ascontiguousarray(bin_type, np.int32), ctypes.c_int32),
+        _ptr(np.ascontiguousarray(missing, np.int32), ctypes.c_int32),
+        _ptr(np.ascontiguousarray(num_bin, np.int32), ctypes.c_int32),
+        _ptr(np.ascontiguousarray(bounds, np.float64), ctypes.c_double),
+        _ptr(np.ascontiguousarray(bounds_off, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(cats, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(cat_bins, np.int32), ctypes.c_int32),
+        _ptr(np.ascontiguousarray(cats_off, np.int64), ctypes.c_int64),
+        1 if out_dtype == np.uint16 else 0,
+        out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        return None
+    return out
+
+
+def predict_forest(X: np.ndarray, flat: dict, num_class: int,
+                   pred_leaf: bool = False) -> Optional[np.ndarray]:
+    """Batch raw prediction over a flattened forest (predictor.cpp),
+    OpenMP over rows; None when the native library is unavailable.
+    `flat` is `ops.predict.flatten_forest(trees)`."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lgbt_predict"):
+        return None
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    n, num_feat = X.shape
+    t_count = len(flat["num_leaves"])
+    if pred_leaf:
+        out = np.empty((n, t_count), np.float64)
+    else:
+        out = np.zeros((n, num_class), np.float64)
+    rc = lib.lgbt_predict(
+        _ptr(X, ctypes.c_double), n, num_feat, t_count,
+        _ptr(flat["node_off"], ctypes.c_int64),
+        _ptr(flat["leaf_off"], ctypes.c_int64),
+        _ptr(flat["left"], ctypes.c_int32),
+        _ptr(flat["right"], ctypes.c_int32),
+        _ptr(flat["feat"], ctypes.c_int32),
+        _ptr(flat["thresh"], ctypes.c_double),
+        _ptr(flat["dtype"], ctypes.c_int8),
+        _ptr(flat["leaf_value"], ctypes.c_double),
+        _ptr(flat["cat_bnd_off"], ctypes.c_int64),
+        _ptr(flat["cat_boundaries"], ctypes.c_int32),
+        _ptr(flat["cat_words_off"], ctypes.c_int64),
+        _ptr(flat["cat_words"], ctypes.c_uint32),
+        _ptr(flat["num_leaves"], ctypes.c_int32),
+        _ptr(flat["tree_class"], ctypes.c_int32),
+        num_class, 1 if pred_leaf else 0,
+        _ptr(out, ctypes.c_double))
+    if rc != 0:
+        return None
+    return out if pred_leaf or num_class > 1 else out[:, 0]

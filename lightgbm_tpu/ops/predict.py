@@ -177,6 +177,58 @@ class TreePredictor:
         return predict_raw_values(self.trees, X, leaf_index=False)
 
 
+def flatten_forest(trees: List[Tree], num_class: int = 1) -> Dict[str, np.ndarray]:
+    """Concatenate per-tree node arrays for the native batch predictor
+    (src/native/predictor.cpp — the reference Predictor's flattened-walk
+    layout). Returns contiguous arrays keyed as the C ABI expects."""
+    t_count = len(trees)
+    node_off = np.zeros(t_count + 1, np.int64)
+    leaf_off = np.zeros(t_count + 1, np.int64)
+    cat_bnd_off = np.zeros(t_count + 1, np.int64)
+    cat_words_off = np.zeros(t_count + 1, np.int64)
+    for i, t in enumerate(trees):
+        node_off[i + 1] = node_off[i] + max(t.num_leaves - 1, 0)
+        leaf_off[i + 1] = leaf_off[i] + t.num_leaves
+        cat_bnd_off[i + 1] = cat_bnd_off[i] + len(t.cat_boundaries)
+        cat_words_off[i + 1] = cat_words_off[i] + len(t.cat_threshold)
+    total_nodes = int(node_off[-1])
+    left = np.empty(max(total_nodes, 1), np.int32)
+    right = np.empty(max(total_nodes, 1), np.int32)
+    feat = np.zeros(max(total_nodes, 1), np.int32)
+    thresh = np.zeros(max(total_nodes, 1), np.float64)
+    dtype = np.zeros(max(total_nodes, 1), np.int8)
+    leaf_value = np.zeros(max(int(leaf_off[-1]), 1), np.float64)
+    cat_boundaries = np.zeros(max(int(cat_bnd_off[-1]), 1), np.int32)
+    cat_words = np.zeros(max(int(cat_words_off[-1]), 1), np.uint32)
+    num_leaves = np.asarray([t.num_leaves for t in trees], np.int32)
+    for i, t in enumerate(trees):
+        n = t.num_leaves - 1
+        a, b = int(node_off[i]), int(node_off[i + 1])
+        if n > 0:
+            left[a:b] = t.left_child[:n]
+            right[a:b] = t.right_child[:n]
+            feat[a:b] = t.split_feature[:n]
+            thresh[a:b] = t.threshold[:n]
+            dtype[a:b] = t.decision_type[:n]
+        la, lb = int(leaf_off[i]), int(leaf_off[i + 1])
+        leaf_value[la:lb] = t.leaf_value[:t.num_leaves]
+        ca, cb = int(cat_bnd_off[i]), int(cat_bnd_off[i + 1])
+        cat_boundaries[ca:cb] = np.asarray(t.cat_boundaries, np.int32)
+        wa, wb = int(cat_words_off[i]), int(cat_words_off[i + 1])
+        if wb > wa:
+            cat_words[wa:wb] = np.asarray(t.cat_threshold, np.uint32)
+    return {
+        "node_off": node_off, "leaf_off": leaf_off,
+        "left": left, "right": right, "feat": feat, "thresh": thresh,
+        "dtype": dtype, "leaf_value": leaf_value,
+        "cat_bnd_off": cat_bnd_off, "cat_boundaries": cat_boundaries,
+        "cat_words_off": cat_words_off, "cat_words": cat_words,
+        "num_leaves": num_leaves,
+        "tree_class": (np.arange(t_count, dtype=np.int32)
+                       % max(num_class, 1)),
+    }
+
+
 def predict_raw_values(trees: List[Tree], X: np.ndarray,
                        leaf_index: bool = False) -> np.ndarray:
     """Vectorized NumPy traversal over raw feature values.
@@ -218,8 +270,12 @@ def predict_raw_values(trees: List[Tree], X: np.ndarray,
                 for j in np.nonzero(is_cat)[0]:
                     v = fval[j]
                     if np.isnan(v):
-                        cat_left[j] = False
-                        continue
+                        # NaN -> right only under missing_type NaN; else it
+                        # degrades to category 0 (tree.h CategoricalDecision)
+                        if mt[j] == 2:
+                            cat_left[j] = False
+                            continue
+                        v = 0.0
                     iv = int(v)
                     if iv < 0:
                         cat_left[j] = False

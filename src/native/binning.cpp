@@ -1,0 +1,270 @@
+// Native binning core for lightgbm_tpu.
+//
+// The reference quantizes features in C++ (BinMapper::FindBin,
+// src/io/bin.cpp:217-419, and the per-row Push/ValueToBin ingest,
+// include/LightGBM/bin.h:461-497) under OpenMP. This file is the tpu
+// build's equivalent host-side hot path: (a) full-matrix value->bin
+// mapping parallel over rows, and (b) numerical bin-boundary search over
+// a sampled column (sort + one-ulp distinct merge + zero-isolated greedy
+// equal-count packing). Semantics mirror lightgbm_tpu/io/binning.py,
+// which remains the pure-Python fallback and the oracle in tests.
+//
+// Build: make -C src/native
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr double kZeroThreshold = 1e-35;
+
+inline double NextAfterUp(double x) { return std::nextafter(x, HUGE_VAL); }
+
+// b <= nextafter(a): equal within one ulp, ordered
+inline bool LeOrdered(double a, double b) { return b <= NextAfterUp(a); }
+
+// first index i in [0, n) with bounds[i] >= v, else n
+inline int32_t LowerBound(const double* bounds, int32_t n, double v) {
+  int32_t lo = 0, hi = n;
+  while (lo < hi) {
+    int32_t mid = (lo + hi) >> 1;
+    if (bounds[mid] < v) lo = mid + 1; else hi = mid;
+  }
+  return lo;
+}
+
+// Greedy equal-ish-count boundaries over sorted distinct values; appends
+// to `bounds` and finishes with +inf. Mirrors binning.py _greedy_find_bin.
+void GreedyFindBin(const double* dv, const int64_t* cnt, int64_t n,
+                   int32_t max_bin, int64_t total_cnt,
+                   int32_t min_data_in_bin, std::vector<double>* bounds) {
+  if (n <= max_bin) {
+    int64_t cur = 0;
+    for (int64_t i = 0; i + 1 < n; ++i) {
+      cur += cnt[i];
+      if (cur >= min_data_in_bin) {
+        double val = NextAfterUp((dv[i] + dv[i + 1]) / 2.0);
+        if (bounds->empty() || !LeOrdered(bounds->back(), val)) {
+          bounds->push_back(val);
+          cur = 0;
+        }
+      }
+    }
+    bounds->push_back(HUGE_VAL);
+    return;
+  }
+  if (min_data_in_bin > 0) {
+    int64_t cap = total_cnt / min_data_in_bin;
+    if (cap < max_bin) max_bin = static_cast<int32_t>(cap);
+    if (max_bin < 1) max_bin = 1;
+  }
+  double mean_bin_size = static_cast<double>(total_cnt) / max_bin;
+  std::vector<char> is_big(n);
+  int64_t big_cnt = 0, big_sum = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    is_big[i] = cnt[i] >= mean_bin_size;
+    if (is_big[i]) { ++big_cnt; big_sum += cnt[i]; }
+  }
+  int64_t rest_bin_cnt = max_bin - big_cnt;
+  int64_t rest_sample_cnt = total_cnt - big_sum;
+  mean_bin_size = static_cast<double>(rest_sample_cnt) /
+                  std::max<int64_t>(rest_bin_cnt, 1);
+  std::vector<double> uppers, lowers;
+  uppers.reserve(max_bin);
+  lowers.reserve(max_bin);
+  lowers.push_back(dv[0]);
+  int64_t cur = 0;
+  for (int64_t i = 0; i + 1 < n; ++i) {
+    if (!is_big[i]) rest_sample_cnt -= cnt[i];
+    cur += cnt[i];
+    if (is_big[i] || cur >= mean_bin_size ||
+        (is_big[i + 1] && cur >= std::max(1.0, mean_bin_size * 0.5))) {
+      uppers.push_back(dv[i]);
+      lowers.push_back(dv[i + 1]);
+      if (static_cast<int32_t>(uppers.size()) >= max_bin - 1) break;
+      cur = 0;
+      if (!is_big[i]) {
+        --rest_bin_cnt;
+        mean_bin_size = static_cast<double>(rest_sample_cnt) /
+                        std::max<int64_t>(rest_bin_cnt, 1);
+      }
+    }
+  }
+  for (size_t i = 0; i < uppers.size(); ++i) {
+    double val = NextAfterUp((uppers[i] + lowers[i + 1]) / 2.0);
+    if (bounds->empty() || !LeOrdered(bounds->back(), val)) {
+      bounds->push_back(val);
+    }
+  }
+  bounds->push_back(HUGE_VAL);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Numerical bin boundaries with the zero region isolated
+// (binning.py _find_bin_zero_as_one / reference FindBinWithZeroAsOneBin
+// semantics). `values`: sampled non-zero, non-NaN entries (unsorted;
+// |v| <= 1e-35 entries are treated as zeros); zeros are implied by
+// total_sample_cnt - (count of non-zero values). Writes ascending upper
+// bounds (last = +inf) into out_bounds (capacity >= max_bin) and returns
+// their count, or -1 on error.
+int32_t lgbt_find_bin_numerical(const double* values, int64_t n_values,
+                                int64_t total_sample_cnt, int32_t max_bin,
+                                int32_t min_data_in_bin,
+                                double* out_bounds) {
+  if (max_bin < 2) return -1;
+  std::vector<double> sorted;
+  sorted.reserve(n_values);
+  int64_t implicit_zero = 0;
+  for (int64_t i = 0; i < n_values; ++i) {
+    double v = values[i];
+    if (v >= -kZeroThreshold && v <= kZeroThreshold) { ++implicit_zero; continue; }
+    sorted.push_back(v);
+  }
+  std::sort(sorted.begin(), sorted.end());
+  int64_t zero_cnt = total_sample_cnt -
+                     static_cast<int64_t>(sorted.size());
+  // distinct values with the zero block spliced into sorted order
+  std::vector<double> dv;
+  std::vector<int64_t> cnt;
+  dv.reserve(sorted.size() + 1);
+  cnt.reserve(sorted.size() + 1);
+  size_t m = sorted.size();
+  if (m == 0 || (sorted[0] > 0.0 && zero_cnt > 0)) {
+    dv.push_back(0.0);
+    cnt.push_back(zero_cnt);
+  }
+  if (m > 0) { dv.push_back(sorted[0]); cnt.push_back(1); }
+  for (size_t i = 1; i < m; ++i) {
+    double prev = sorted[i - 1], curv = sorted[i];
+    if (!LeOrdered(prev, curv)) {
+      if (prev < 0.0 && curv > 0.0) { dv.push_back(0.0); cnt.push_back(zero_cnt); }
+      dv.push_back(curv);
+      cnt.push_back(1);
+    } else {
+      dv.back() = curv;
+      ++cnt.back();
+    }
+  }
+  if (m > 0 && sorted[m - 1] < 0.0 && zero_cnt > 0) {
+    dv.push_back(0.0);
+    cnt.push_back(zero_cnt);
+  }
+
+  int64_t n = static_cast<int64_t>(dv.size());
+  int64_t left_cnt_data = 0, right_cnt_data = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (dv[i] <= -kZeroThreshold) left_cnt_data += cnt[i];
+    else if (dv[i] > kZeroThreshold) right_cnt_data += cnt[i];
+  }
+  int64_t cnt_zero = total_sample_cnt - left_cnt_data - right_cnt_data;
+  int64_t left_cnt = n;  // first index not in the negative region
+  for (int64_t i = 0; i < n; ++i) {
+    if (!(dv[i] <= -kZeroThreshold)) { left_cnt = i; break; }
+  }
+  std::vector<double> bounds;
+  if (left_cnt > 0) {
+    int64_t denom = std::max<int64_t>(total_sample_cnt - cnt_zero, 1);
+    int32_t left_max_bin = std::max<int32_t>(
+        1, static_cast<int32_t>(
+               static_cast<double>(left_cnt_data) / denom * (max_bin - 1)));
+    GreedyFindBin(dv.data(), cnt.data(), left_cnt, left_max_bin,
+                  left_cnt_data, min_data_in_bin, &bounds);
+    bounds.back() = -kZeroThreshold;
+  }
+  int64_t right_start = -1;
+  for (int64_t i = left_cnt; i < n; ++i) {
+    if (dv[i] > kZeroThreshold) { right_start = i; break; }
+  }
+  if (right_start >= 0) {
+    int32_t right_max_bin =
+        max_bin - 1 - static_cast<int32_t>(bounds.size());
+    if (right_max_bin <= 0) return -1;
+    bounds.push_back(kZeroThreshold);
+    GreedyFindBin(dv.data() + right_start, cnt.data() + right_start,
+                  n - right_start, right_max_bin, right_cnt_data,
+                  min_data_in_bin, &bounds);
+  } else {
+    bounds.push_back(HUGE_VAL);
+  }
+  if (static_cast<int32_t>(bounds.size()) > max_bin) return -1;
+  std::memcpy(out_bounds, bounds.data(), bounds.size() * sizeof(double));
+  return static_cast<int32_t>(bounds.size());
+}
+
+// Full-matrix value->bin ingest, parallel over rows (the analogue of the
+// reference's OpenMP PushOneRow loops, dataset_loader.cpp:963+).
+//
+//   data       [n, f_total] row-major, f64 (dtype_code 0) or f32 (1)
+//   col_idx    [f_used] original column of each output column
+//   bin_type   [f_used] 0 numerical, 1 categorical
+//   missing    [f_used] 0 none, 1 zero, 2 nan
+//   num_bin    [f_used]
+//   bounds     concatenated per-feature bin_upper_bound arrays
+//   bounds_off [f_used+1] offsets into `bounds`
+//   cats       concatenated per-feature SORTED category values
+//   cat_bins   matching bin index per sorted category
+//   cats_off   [f_used+1] offsets into `cats`/`cat_bins`
+//   out        [n, f_used] u8 (out_is_u16=0) or u16 (1), row-major
+int32_t lgbt_bin_matrix(const void* data, int32_t dtype_code, int64_t n,
+                        int64_t f_total, const int32_t* col_idx,
+                        int64_t f_used, const int32_t* bin_type,
+                        const int32_t* missing, const int32_t* num_bin,
+                        const double* bounds, const int64_t* bounds_off,
+                        const int64_t* cats, const int32_t* cat_bins,
+                        const int64_t* cats_off, int32_t out_is_u16,
+                        void* out) {
+  const double* d64 = static_cast<const double*>(data);
+  const float* d32 = static_cast<const float*>(data);
+  uint8_t* o8 = static_cast<uint8_t*>(out);
+  uint16_t* o16 = static_cast<uint16_t*>(out);
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const int64_t in_base = r * f_total;
+    const int64_t out_base = r * f_used;
+    for (int64_t j = 0; j < f_used; ++j) {
+      double v = dtype_code == 0 ? d64[in_base + col_idx[j]]
+                                 : static_cast<double>(d32[in_base + col_idx[j]]);
+      int32_t nb = num_bin[j];
+      int32_t b;
+      if (bin_type[j] == 0) {
+        int32_t r_hi = nb - 1 - (missing[j] == 2 ? 1 : 0);
+        if (std::isnan(v)) {
+          b = missing[j] == 2 ? nb - 1
+                              : LowerBound(bounds + bounds_off[j], r_hi, 0.0);
+        } else {
+          b = LowerBound(bounds + bounds_off[j], r_hi, v);
+        }
+      } else {
+        b = nb - 1;
+        int64_t iv = std::isnan(v) ? -1 : static_cast<int64_t>(v);
+        if (iv >= 0) {
+          const int64_t* cs = cats + cats_off[j];
+          const int32_t* cb = cat_bins + cats_off[j];
+          int64_t cn = cats_off[j + 1] - cats_off[j];
+          int64_t lo = 0, hi = cn;
+          while (lo < hi) {
+            int64_t mid = (lo + hi) >> 1;
+            if (cs[mid] < iv) lo = mid + 1; else hi = mid;
+          }
+          if (lo < cn && cs[lo] == iv) b = cb[lo];
+        }
+      }
+      if (out_is_u16) o16[out_base + j] = static_cast<uint16_t>(b);
+      else o8[out_base + j] = static_cast<uint8_t>(b);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

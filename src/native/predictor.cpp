@@ -1,0 +1,128 @@
+// Native batch predictor for lightgbm_tpu.
+//
+// The reference serves file/matrix prediction from C++ with one OpenMP
+// task per row walking every tree (src/application/predictor.hpp:66-115,
+// src/boosting/gbdt_prediction.cpp, Tree::Predict node walk
+// include/LightGBM/tree.h:112-130). This is the tpu build's native serving
+// path for host-resident inputs: trees are flattened into concatenated
+// node arrays (one memcpy per model export) and rows are walked in
+// parallel. Decision semantics mirror Tree::NumericalDecision /
+// CategoricalDecision (tree.h:216-270) in f64, identical to
+// models/tree.py Tree._decision.
+//
+// Build: make -C src/native
+#include <cmath>
+#include <cstdint>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr int8_t kCategoricalMask = 1;
+constexpr int8_t kDefaultLeftMask = 2;
+
+struct Forest {
+  const int64_t* node_off;    // [T+1] internal-node base per tree
+  const int64_t* leaf_off;    // [T+1] leaf base per tree
+  const int32_t* left;        // concatenated child links (~leaf = leaf)
+  const int32_t* right;
+  const int32_t* feat;        // real (original) feature index
+  const double* thresh;       // f64 thresholds; cat nodes: bitset index
+  const int8_t* dtype;        // decision_type bit packing
+  const double* leaf_value;
+  const int64_t* cat_bnd_off;   // [T+1] offsets into cat_boundaries
+  const int32_t* cat_boundaries;  // per-tree word boundaries (leading 0)
+  const int64_t* cat_words_off;   // [T+1] offsets into cat_words
+  const uint32_t* cat_words;
+};
+
+// returns ~leaf when done; node walk for one row in one tree
+inline int32_t WalkTree(const Forest& f, int32_t t, const double* row) {
+  int64_t nb = f.node_off[t];
+  int32_t node = 0;
+  for (;;) {
+    int64_t g = nb + node;
+    double fval = row[f.feat[g]];
+    int8_t d = f.dtype[g];
+    int32_t next;
+    if (d & kCategoricalMask) {
+      int32_t mt = (d >> 2) & 3;
+      int64_t iv;
+      if (std::isnan(fval)) {
+        if (mt == 2) { next = f.right[g]; goto advance; }
+        iv = 0;
+      } else {
+        iv = static_cast<int64_t>(fval);
+        if (iv < 0) { next = f.right[g]; goto advance; }
+      }
+      {
+        int32_t ci = static_cast<int32_t>(f.thresh[g]);
+        const int32_t* bnd = f.cat_boundaries + f.cat_bnd_off[t];
+        int32_t lo = bnd[ci], hi = bnd[ci + 1];
+        int64_t w = iv >> 5;
+        bool in = w < (hi - lo) &&
+                  ((f.cat_words[f.cat_words_off[t] + lo + w] >>
+                    (iv & 31)) & 1u);
+        next = in ? f.left[g] : f.right[g];
+      }
+    } else {
+      int32_t mt = (d >> 2) & 3;
+      double v = fval;
+      if (std::isnan(v) && mt != 2) v = 0.0;
+      bool is_default = (mt == 1 && v >= -1e-35 && v <= 1e-35) ||
+                        (mt == 2 && std::isnan(v));
+      bool go_left = is_default ? (d & kDefaultLeftMask) != 0
+                                : v <= f.thresh[g];
+      next = go_left ? f.left[g] : f.right[g];
+    }
+  advance:
+    if (next < 0) return next;
+    node = next;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batch raw prediction over a flattened forest.
+//   X            [n, num_feat] row-major f64 raw feature values
+//   num_leaves   [T]; single-leaf trees contribute leaf_value[leaf_off[t]]
+//   tree_class   [T] class slot of each tree (0 for single-class)
+//   mode         0: out[n, num_class] += leaf values (raw score)
+//                1: out[n, T] = leaf index per tree (pred_leaf)
+// out must be zero-initialized by the caller for mode 0.
+int32_t lgbt_predict(const double* X, int64_t n, int64_t num_feat,
+                     int32_t num_trees, const int64_t* node_off,
+                     const int64_t* leaf_off, const int32_t* left,
+                     const int32_t* right, const int32_t* feat,
+                     const double* thresh, const int8_t* dtype,
+                     const double* leaf_value, const int64_t* cat_bnd_off,
+                     const int32_t* cat_boundaries,
+                     const int64_t* cat_words_off, const uint32_t* cat_words,
+                     const int32_t* num_leaves, const int32_t* tree_class,
+                     int32_t num_class, int32_t mode, double* out) {
+  Forest f{node_off, leaf_off, left, right, feat, thresh, dtype,
+           leaf_value, cat_bnd_off, cat_boundaries, cat_words_off,
+           cat_words};
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const double* row = X + r * num_feat;
+    double* orow = out + r * (mode == 1 ? num_trees : num_class);
+    for (int32_t t = 0; t < num_trees; ++t) {
+      int32_t leaf = num_leaves[t] <= 1 ? 0 : ~WalkTree(f, t, row);
+      if (mode == 1) {
+        orow[t] = leaf;
+      } else {
+        orow[tree_class[t]] += leaf_value[leaf_off[t] + leaf];
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

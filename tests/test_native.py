@@ -88,3 +88,150 @@ def test_loader_uses_native(tmp_path):
     labels, feats, extras = loader.parse_file(path)
     assert feats.shape == (100, 5)
     assert set(np.unique(labels)) <= {0.0, 1.0}
+
+
+# ---------------------------------------------------------------------------
+# native binning core (src/native/binning.cpp)
+# ---------------------------------------------------------------------------
+def _py_mapper(values, total, max_bin=255, **kw):
+    """Force the pure-Python find_bin path as the oracle."""
+    from unittest import mock
+
+    from lightgbm_tpu.io.binning import BinMapper
+    m = BinMapper()
+    with mock.patch.object(BinMapper, "_native_numerical_bounds",
+                           return_value=None):
+        m.find_bin(values, total_sample_cnt=total, max_bin=max_bin, **kw)
+    return m
+
+
+def _native_mapper(values, total, max_bin=255, **kw):
+    from lightgbm_tpu.io.binning import BinMapper
+    m = BinMapper()
+    m.find_bin(values, total_sample_cnt=total, max_bin=max_bin, **kw)
+    return m
+
+
+@pytest.mark.parametrize("case", ["normal", "heavy_ties", "with_nan",
+                                  "with_zeros", "all_negative",
+                                  "few_distinct", "zero_as_missing"])
+def test_find_bin_native_matches_python(case):
+    rng = np.random.RandomState(7)
+    kw = {}
+    if case == "normal":
+        vals = rng.randn(5000) * 10
+        total = 5000
+    elif case == "heavy_ties":
+        vals = rng.randint(-20, 20, 5000).astype(np.float64)
+        vals = vals[np.abs(vals) > 0.5]
+        total = 5000
+    elif case == "with_nan":
+        vals = rng.randn(3000)
+        vals[rng.rand(3000) < 0.1] = np.nan
+        total = 3000
+    elif case == "with_zeros":
+        vals = rng.randn(2000)
+        vals = vals[np.abs(vals) > 1e-35]
+        total = 6000  # 4000 implied zeros
+    elif case == "all_negative":
+        vals = -np.abs(rng.randn(2000)) - 0.1
+        total = 2500
+    elif case == "few_distinct":
+        vals = rng.choice([1.5, 2.5, 3.5, -1.0], 1000)
+        total = 1200
+    else:  # zero_as_missing
+        vals = rng.randn(2000)
+        vals = vals[np.abs(vals) > 1e-35]
+        total = 5000
+        kw = {"zero_as_missing": True}
+    mp = _py_mapper(vals, total, **kw)
+    mn = _native_mapper(vals, total, **kw)
+    assert mn.num_bin == mp.num_bin
+    assert mn.missing_type == mp.missing_type
+    assert mn.is_trivial == mp.is_trivial
+    np.testing.assert_array_equal(mn.bin_upper_bound, mp.bin_upper_bound)
+    assert mn.default_bin == mp.default_bin
+    assert abs(mn.sparse_rate - mp.sparse_rate) < 1e-12
+
+
+def test_bin_matrix_native_matches_python():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset
+    rng = np.random.RandomState(3)
+    n = 4000
+    X = rng.randn(n, 6)
+    X[:, 1] = rng.randint(0, 12, n)          # categorical
+    X[rng.rand(n) < 0.05, 0] = np.nan        # missing
+    X[:, 2] = np.where(rng.rand(n) < 0.6, 0.0, X[:, 2])  # sparse
+    cfg = Config.from_params({"max_bin": 63, "verbosity": -1})
+    ds = Dataset.from_matrix(X, label=rng.rand(n), config=cfg,
+                             categorical_feature=[1])
+    py = np.empty_like(ds.bins)
+    for col, j in enumerate(ds.real_feature_idx):
+        py[:, col] = ds.mappers[j].values_to_bins(
+            np.asarray(X[:, j], np.float64)).astype(ds.bins.dtype)
+    np.testing.assert_array_equal(ds.bins, py)
+
+
+def test_bin_matrix_f32_input():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset
+    rng = np.random.RandomState(4)
+    X = rng.randn(1000, 4).astype(np.float32)
+    cfg = Config.from_params({"max_bin": 255, "verbosity": -1})
+    ds = Dataset.from_matrix(X, label=rng.rand(1000), config=cfg)
+    py = np.empty_like(ds.bins)
+    for col, j in enumerate(ds.real_feature_idx):
+        py[:, col] = ds.mappers[j].values_to_bins(
+            np.asarray(X[:, j], np.float64)).astype(ds.bins.dtype)
+    np.testing.assert_array_equal(ds.bins, py)
+
+
+# ---------------------------------------------------------------------------
+# native predictor (src/native/predictor.cpp)
+# ---------------------------------------------------------------------------
+def test_native_predictor_matches_numpy_walk():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.native import predict_forest
+    from lightgbm_tpu.ops.predict import flatten_forest, predict_raw_values
+    rng = np.random.RandomState(5)
+    n = 2000
+    X = rng.randn(n, 8)
+    X[:, 3] = rng.randint(0, 10, n)
+    X[rng.rand(n) < 0.04, 0] = np.nan
+    y = (X[:, 0] + X[:, 1] * (X[:, 3] > 4) > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[3])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    trees = bst.trees
+    flat = flatten_forest(trees, 1)
+    out = predict_forest(X, flat, 1)
+    oracle = predict_raw_values(trees, X)
+    np.testing.assert_allclose(out, oracle, rtol=0, atol=0)
+    # leaf indices
+    leaves = predict_forest(X, flat, 1, pred_leaf=True)
+    oracle_leaves = predict_raw_values(trees, X, leaf_index=True)
+    np.testing.assert_array_equal(leaves.astype(np.int32), oracle_leaves)
+
+
+def test_native_predictor_multiclass():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.native import predict_forest
+    from lightgbm_tpu.ops.predict import flatten_forest, predict_raw_values
+    rng = np.random.RandomState(6)
+    n = 1500
+    X = rng.randn(n, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1}, ds,
+                    num_boost_round=5)
+    trees = bst.trees
+    k = bst.num_tree_per_iteration
+    flat = flatten_forest(trees, k)
+    out = predict_forest(X, flat, k)
+    oracle = np.zeros((n, k))
+    for cls in range(k):
+        cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
+        oracle[:, cls] = predict_raw_values(cls_trees, X)
+    np.testing.assert_allclose(out, oracle, rtol=0, atol=0)
