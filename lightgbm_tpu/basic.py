@@ -29,7 +29,7 @@ from .ops.predict import flatten_forest, predict_raw_values
 
 
 def _native_predict(trees, X, num_class: int, pred_leaf: bool = False,
-                    flat=None):
+                    flat=None, es_freq: int = 0, es_margin: float = 0.0):
     """Batch predict through the native OpenMP predictor
     (src/native/predictor.cpp); None -> caller uses the NumPy walk."""
     from . import native
@@ -42,10 +42,35 @@ def _native_predict(trees, X, num_class: int, pred_leaf: bool = False,
             f"data has {X.shape[1]} features but the model was trained "
             f"with at least {int(flat['feat'].max()) + 1}")
     out = native.predict_forest(np.asarray(X, np.float64), flat,
-                                num_class, pred_leaf)
+                                num_class, pred_leaf, es_freq, es_margin)
     if out is None or pred_leaf:
         return out
     return out.reshape(len(X), num_class) if out.ndim == 1 else out
+
+
+def _early_stop_predict_py(trees, X, num_class: int, es_freq: int,
+                           es_margin: float) -> np.ndarray:
+    """Pure-Python fallback for prediction early stopping (reference
+    prediction_early_stop.cpp): per row, walk trees until the margin test
+    passes at a freq boundary. `es_freq` is in TREES (the caller scales
+    the per-iteration freq by num_class so checks land on iteration
+    boundaries, like the reference)."""
+    X = np.asarray(X, np.float64)
+    n = len(X)
+    out = np.zeros((n, num_class), np.float64)
+    for i in range(n):
+        acc = out[i]
+        for t, tree in enumerate(trees):
+            acc[t % num_class] += tree.predict_row(X[i])
+            if es_freq > 0 and (t + 1) % es_freq == 0 and t + 1 < len(trees):
+                if num_class <= 1:
+                    if abs(acc[0]) > es_margin:
+                        break
+                else:
+                    top = np.sort(acc)[-2:]
+                    if top[1] - top[0] > es_margin:
+                        break
+    return out
 
 
 class LightGBMError(Exception):
@@ -58,10 +83,52 @@ def _to_matrix(data) -> np.ndarray:
     if isinstance(data, (list, tuple)):
         return np.asarray(data, np.float64)
     if hasattr(data, "values"):  # pandas
-        return np.asarray(data.values, np.float64)
+        return _data_from_pandas(data)[0]  # categories re-derived; callers
+        # needing train-time alignment pass pandas_categorical explicitly
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), np.float64)
     raise LightGBMError(f"Cannot convert data of type {type(data)}")
+
+
+def _data_from_pandas(df, pandas_categorical=None):
+    """DataFrame -> (matrix, feature_names, cat columns, cat categories).
+
+    Mirrors the reference's pandas handling (basic.py:255-298): `category`
+    dtype columns become their integer codes (NaN -> -1 -> missing), object
+    columns are rejected, and column names become feature names. When
+    `pandas_categorical` (the TRAINING category lists, in categorical-
+    column order) is given, codes are remapped onto those categories so
+    predict-time frames with different category sets stay aligned
+    (reference stores pandas_categorical in the model for this)."""
+    feature_names = [str(c) for c in df.columns]
+    cat_cols = []
+    cat_categories = []
+    arrs = []
+    cat_i = 0
+    for i, col in enumerate(df.columns):
+        s = df[col]
+        if str(s.dtype) == "category":
+            cat_cols.append(i)
+            if pandas_categorical is not None:
+                if cat_i >= len(pandas_categorical):
+                    raise LightGBMError(
+                        "train and predict DataFrames have different "
+                        "numbers of categorical columns")
+                train_cats = list(pandas_categorical[cat_i])
+                s = s.cat.set_categories(train_cats)
+            cat_categories.append([c for c in s.cat.categories])
+            cat_i += 1
+            codes = s.cat.codes.to_numpy().astype(np.float64)
+            codes = np.where(codes < 0, np.nan, codes)
+            arrs.append(codes)
+        elif s.dtype == object:
+            raise LightGBMError(
+                f"DataFrame.dtypes for column {col} must be int, float or "
+                "bool (or category)")
+        else:
+            arrs.append(s.to_numpy().astype(np.float64))
+    return np.column_stack(arrs) if arrs else np.empty((len(df), 0)), \
+        feature_names, cat_cols, cat_categories
 
 
 class Dataset:
@@ -85,6 +152,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._handle: Optional[_CoreDataset] = None
         self.used_indices: Optional[np.ndarray] = None
+        self.pandas_categorical = None
         self._predictor = None
 
     # ------------------------------------------------------------------
@@ -105,11 +173,33 @@ class Dataset:
                 self._handle.metadata.set_group(self.group)
             return self
         cfg = Config.from_params(self.params)
-        mat = _to_matrix(self.data)
         feature_names = (None if self.feature_name in ("auto", None)
                          else list(self.feature_name))
-        cats = (None if self.categorical_feature in ("auto", None)
-                else [int(c) for c in self.categorical_feature])
+        raw_cats = (None if self.categorical_feature in ("auto", None)
+                    else list(self.categorical_feature))
+        if hasattr(self.data, "values") and hasattr(self.data, "columns"):
+            mat, pd_names, pd_cats, pd_categories = \
+                _data_from_pandas(self.data)
+            if feature_names is None:
+                feature_names = pd_names
+            if raw_cats is None and pd_cats:
+                raw_cats = pd_cats
+            self.pandas_categorical = pd_categories or None
+        else:
+            mat = _to_matrix(self.data)
+        cats = None
+        if raw_cats is not None:
+            cats = []
+            for c in raw_cats:
+                if isinstance(c, str):
+                    # column-name form (the standard pandas idiom,
+                    # reference basic.py categorical_feature handling)
+                    if feature_names is None or c not in feature_names:
+                        raise LightGBMError(
+                            f"Unknown categorical feature name: {c!r}")
+                    cats.append(feature_names.index(c))
+                else:
+                    cats.append(int(c))
         self._handle = _CoreDataset.from_matrix(
             mat, label=self.label, config=cfg, weight=self.weight,
             group=self.group, init_score=self.init_score,
@@ -201,6 +291,15 @@ class Dataset:
         self._handle.save_binary(filename)
         return self
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append `other`'s features column-wise (reference
+        basic.py add_features_from -> LGBM_DatasetAddFeaturesFrom;
+        both datasets must be constructed over the same rows)."""
+        self.construct()
+        other.construct()
+        self._handle.add_features_from(other._handle)
+        return self
+
     def _update_params(self, params) -> "Dataset":
         self.params.update(params or {})
         return self
@@ -219,6 +318,7 @@ class Booster:
         self.best_score: Dict = {}
         self._flat_cache: Optional[tuple] = None
         self._model_gen = 0
+        self.pandas_categorical = None
         self._train_set = train_set
         self._gbdt: Optional[GBDT] = None
         self._loaded: Optional[Dict] = None
@@ -234,6 +334,7 @@ class Booster:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be Dataset instance")
             train_set.construct()
+            self.pandas_categorical = train_set.pandas_categorical
             cfg = Config.from_params(self.params)
             self._cfg = cfg
             self._gbdt = create_boosting(cfg, train_set._handle)
@@ -243,6 +344,13 @@ class Booster:
 
     # ------------------------------------------------------------------
     def _init_from_string(self, text: str) -> None:
+        for line in text.splitlines():
+            if line.startswith("pandas_categorical:"):
+                try:
+                    self.pandas_categorical = json.loads(
+                        line.split(":", 1)[1])
+                except json.JSONDecodeError:
+                    pass
         self._loaded = load_model_from_string(text)
         loaded_params = dict(self._loaded.get("params", {}))
         self.params = {**loaded_params, **self.params}
@@ -388,7 +496,13 @@ class Booster:
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
-        X = _to_matrix(data)
+        if (self.pandas_categorical and hasattr(data, "columns")
+                and hasattr(data, "values")):
+            # remap predict-time category codes onto the TRAINING
+            # categories (reference pandas_categorical model field)
+            X = _data_from_pandas(data, self.pandas_categorical)[0]
+        else:
+            X = _to_matrix(data)
         k = self.num_tree_per_iteration
         if num_iteration is None or num_iteration <= 0:
             num_iteration = (self.best_iteration
@@ -416,12 +530,29 @@ class Booster:
             from .ops.shap import predict_contrib
             return predict_contrib(trees, X, k)
         n = len(X)
-        raw = _native_predict(trees, X, k, flat=flat)
+        # prediction early stopping (reference prediction_early_stop.cpp):
+        # enabled via params/kwargs, classification objectives only, and
+        # the margin test fires at ITERATION boundaries (k trees each)
+        opts = {**self.params, **kwargs}
+        obj_name = str(opts.get("objective", self.params.get(
+            "objective", ""))).split(" ")[0]
+        es_ok_obj = k > 1 or obj_name == "binary"
+        es_on = (bool(opts.get("pred_early_stop", False)) and not raw_score
+                 and es_ok_obj)
+        es_freq = int(opts.get("pred_early_stop_freq", 10)) * k
+        es_margin = float(opts.get("pred_early_stop_margin", 10.0))
+        raw = _native_predict(trees, X, k, flat=flat,
+                              es_freq=es_freq if es_on else 0,
+                              es_margin=es_margin)
         if raw is None:
-            raw = np.zeros((n, k), np.float64)
-            for cls in range(k):
-                cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
-                raw[:, cls] = predict_raw_values(cls_trees, X)
+            if es_on:
+                raw = _early_stop_predict_py(trees, X, k, es_freq, es_margin)
+            else:
+                raw = np.zeros((n, k), np.float64)
+                for cls in range(k):
+                    cls_trees = [t for i, t in enumerate(trees)
+                                 if i % k == cls]
+                    raw[:, cls] = predict_raw_values(cls_trees, X)
         if self._is_average_output():
             raw = raw / max(1, len(trees) // k)
         objective = self._objective_for_predict()
@@ -454,19 +585,29 @@ class Booster:
             ds = self._gbdt.train_data
             obj = self._gbdt.objective
             obj_str = self._objective_string(obj)
-            return save_model_to_string(
+            out = save_model_to_string(
                 self._gbdt.materialized_models(), self._cfg,
                 self.num_tree_per_iteration,
                 ds.num_total_features - 1, ds.feature_names,
                 _feature_infos(ds.mappers), num_iteration, obj_str)
-        # loaded model: re-serialize
-        fn = self._loaded.get("feature_names") or []
-        return save_model_to_string(
-            self._loaded["trees"], self._cfg,
-            self._loaded["num_tree_per_iteration"],
-            self._loaded.get("max_feature_idx", max(len(fn) - 1, 0)),
-            fn, self._loaded.get("feature_infos"), num_iteration,
-            self._loaded.get("objective", ""))
+        else:
+            # loaded model: re-serialize
+            fn = self._loaded.get("feature_names") or []
+            out = save_model_to_string(
+                self._loaded["trees"], self._cfg,
+                self._loaded["num_tree_per_iteration"],
+                self._loaded.get("max_feature_idx", max(len(fn) - 1, 0)),
+                fn, self._loaded.get("feature_infos"), num_iteration,
+                self._loaded.get("objective", ""))
+        # reference stores the pandas category lists as a model trailer
+        # (python-package basic.py) so predict-time frames stay aligned
+        if self.pandas_categorical:
+            try:
+                out += "\npandas_categorical:" + json.dumps(
+                    self.pandas_categorical) + "\n"
+            except TypeError:
+                pass
+        return out
 
     @staticmethod
     def _objective_string(obj) -> str:

@@ -346,14 +346,27 @@ class Config:
     tpu_use_f64_hist: bool = False      # accumulate histograms in f64 (2x pass)
     tpu_hist_chunk: int = 1 << 16        # rows per histogram matmul chunk
     # pallas VMEM-resident histogram kernel (ops/pallas_hist.py, the
-    # ocl/histogram256.cl analogue); off by default until it beats the XLA
-    # one-hot contraction on the target shapes — flip to measure
-    tpu_use_pallas: bool = False
+    # ocl/histogram256.cl analogue): the one-hot tile never leaves VMEM,
+    # vs the XLA einsum path whose chunk one-hots round-trip through HBM
+    tpu_use_pallas: bool = True
     # trace gradients + tree build + score update as ONE program per
     # boosting iteration (saves per-program launch latency on tunneled
     # runtimes, but XLA compile time for the merged program is prohibitive
-    # at large row counts — measure before enabling)
+    # at large row counts — measured >15 min at 10.5M rows vs 132 s for
+    # the split programs; enable only for small/medium datasets)
     tpu_fuse_iteration: bool = False
+    # tree growth strategy. "leafwise" (default): the strictly sequential
+    # reference order (serial_tree_learner.cpp:173-237) as one fused
+    # whole-tree device program. "level"/"auto": the speculative
+    # level-batched builder (models/level_builder.py) — exact leaf-wise
+    # via host replay with automatic fallback — kept opt-in: on v5e its
+    # per-round full-array passes (fills + record-carrying sort) measure
+    # on par with the leaf-wise program, not faster
+    tpu_grow_mode: str = "leafwise"
+    # speculation slots as a multiple of num_leaves for the level builder;
+    # larger values make the exact leaf-wise replay succeed on more skewed
+    # trees at the cost of extra speculative histogram work
+    tpu_level_spec: float = 3.0
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
     tpu_mesh_axis: str = "data"          # mesh axis name for row sharding
 

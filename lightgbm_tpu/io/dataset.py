@@ -329,6 +329,42 @@ class Dataset:
         return out
 
     # ------------------------------------------------------------------
+    def add_features_from(self, other: "Dataset") -> None:
+        """Column-wise merge of another constructed dataset into this one
+        (reference `Dataset::AddFeaturesFrom`, dataset.cpp:349-437 /
+        python basic.py add_features_from, covered by the reference
+        test_basic.py:96-219). Both datasets must hold the same rows;
+        `other`'s metadata is discarded, its features are appended."""
+        if self.num_data != other.num_data:
+            raise ValueError(
+                f"Cannot add features from a dataset with {other.num_data} "
+                f"rows to one with {self.num_data} rows")
+        off = self.num_total_features
+        self.mappers = self.mappers + other.mappers
+        self.feature_names = self.feature_names + other.feature_names
+        self.num_total_features += other.num_total_features
+        other_map = other.used_feature_map.copy()
+        shift = self.num_features
+        other_map[other_map >= 0] += shift
+        self.used_feature_map = np.concatenate(
+            [self.used_feature_map, other_map])
+        self.real_feature_idx = np.concatenate(
+            [self.real_feature_idx, other.real_feature_idx + off])
+        if self.bins is None:
+            self.bins = other.bins
+        elif other.bins is not None:
+            dtype = (np.uint16 if np.uint16 in (self.bins.dtype,
+                                                other.bins.dtype)
+                     else np.uint8)
+            self.bins = np.concatenate(
+                [self.bins.astype(dtype), other.bins.astype(dtype)], axis=1)
+        self.monotone_constraints = np.concatenate(
+            [self.monotone_constraints,
+             other.monotone_constraints]).astype(np.int8)
+        self.feature_penalty = np.concatenate(
+            [self.feature_penalty, other.feature_penalty])
+
+    # ------------------------------------------------------------------
     # binary serialization (reference Dataset::SaveBinaryFile /
     # DatasetLoader::LoadFromBinFile)
     def save_binary(self, path: str) -> None:

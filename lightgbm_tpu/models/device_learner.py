@@ -73,7 +73,14 @@ RI_W = 8
 
 
 class TreeRecord(NamedTuple):
-    """Per-split records of one grown tree (device pytree)."""
+    """Per-split records of one grown tree (device pytree).
+
+    The level builder (level_builder.py) replays speculated splits on the
+    host and emits a NumPy TreeRecord whose physical partition is FINER
+    than the committed tree; there the block_* fields carry the
+    (begin, count, covering committed leaf value) tables that the
+    partition score update consumes instead of the leaf_* fields.
+    """
     num_splits: jax.Array          # i32 scalar: actual splits made
     leaf: jax.Array                # i32[L-1] leaf id split at step s
     feature: jax.Array             # i32[L-1] inner feature index
@@ -91,10 +98,58 @@ class TreeRecord(NamedTuple):
     leaf_count_arr: jax.Array      # i32[L]
     leaf_begin: jax.Array          # i32[L] partition begins
     leaf_cnt_part: jax.Array       # i32[L]
+    block_begin: Optional[jax.Array] = None    # i32[S] physical blocks
+    block_cnt: Optional[jax.Array] = None      # i32[S]
+    block_value: Optional[jax.Array] = None    # f32[S] covering leaf value
 
 
 def _pow2ceil(n: int) -> int:
     return 1 << max(0, int(math.ceil(math.log2(max(n, 1)))))
+
+
+def pack_best_payload(out: Dict, gain: jax.Array):
+    """Pack the winning feature's split into (vecF, vecI, bitset) rows —
+    shared by the leaf-wise and level builders (BF_*/BI_* lanes)."""
+    f = jnp.argmax(gain)
+    vecF = jnp.zeros(BF_W, jnp.float32)
+    vecF = vecF.at[BF_GAIN].set(gain[f])
+    vecF = vecF.at[BF_LG].set(out["left_g"][f])
+    vecF = vecF.at[BF_LH].set(out["left_h"][f])
+    vecF = vecF.at[BF_RG].set(out["right_g"][f])
+    vecF = vecF.at[BF_RH].set(out["right_h"][f])
+    vecF = vecF.at[BF_LOUT].set(out["left_output"][f])
+    vecF = vecF.at[BF_ROUT].set(out["right_output"][f])
+    vecI = jnp.zeros(BI_W, jnp.int32)
+    vecI = vecI.at[BI_FEAT].set(f.astype(jnp.int32))
+    vecI = vecI.at[BI_THR].set(out["threshold"][f])
+    vecI = vecI.at[BI_LC].set(out["left_c"][f])
+    vecI = vecI.at[BI_RC].set(out["right_c"][f])
+    vecI = vecI.at[BI_DEFLEFT].set(out["default_left"][f].astype(jnp.int32))
+    vecI = vecI.at[BI_ISCAT].set(out["is_cat"][f].astype(jnp.int32))
+    return vecF, vecI, out["cat_bitset"][f]
+
+
+def bucket_table(min_pad: int, root_count: int) -> List[int]:
+    """~sqrt(2)-spaced leaf-size table (pow2 plus 1.5x midpoints rounded
+    up to 512) for the dynamic-leaf switch: the average pad factor on the
+    gather/histogram/partition work drops from ~1.5x to ~1.2x for ~2x the
+    compiled branches."""
+    cands = []
+    s = min_pad
+    while True:
+        cands.append(s)
+        mid = (s * 3 // 2 + 511) & ~511
+        if mid > s:
+            cands.append(mid)
+        if s >= root_count:
+            break
+        s <<= 1
+    out = []
+    for sz in sorted(set(cands)):
+        out.append(sz)
+        if sz >= root_count:
+            break
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
@@ -208,6 +263,55 @@ class DeviceTreeLearner:
             self._bins_dev = jnp.asarray(self.ds.bins)
         return self._bins_dev
 
+    # ------------------------------------------------------------------
+    def level_mode_ok(self) -> bool:
+        """True when the level-batched builder (`level_builder.py`) can grow
+        trees for this learner: uint8 bins, serial/data parallelism, and the
+        grow mode allows it. Bagged iterations always use the leaf-wise
+        path (the level records assume a full fresh root)."""
+        return (self.cfg.tpu_grow_mode in ("auto", "level")
+                and self.parallel_mode in ("serial", "data")
+                and self.ds.bins is not None
+                and self.ds.bins.dtype == np.uint8
+                and self.num_features > 0
+                and self.cfg.num_leaves >= 2)
+
+    @property
+    def words_dev(self) -> jax.Array:
+        """Packed bin words [ceil(F/4), N] for the level builder (lazy)."""
+        if getattr(self, "_words_dev", None) is None:
+            from .level_builder import pack_bin_words
+            bins = np.asarray(self.ds.bins)
+            if self.num_features != self.num_real_features:
+                pad = self.num_features - self.num_real_features
+                bins = np.pad(bins, ((0, 0), (0, pad)))
+            self._words_dev = jnp.asarray(pack_bin_words(bins))
+        return self._words_dev
+
+    def _level_fn(self):
+        fn = self._build_cache.get("level")
+        if fn is None:
+            from .level_builder import make_level_build_fn
+            fn = make_level_build_fn(self)
+            self._build_cache["level"] = fn
+        return fn
+
+    def _level_train_fresh(self, grad, hess, feature_mask):
+        """Speculative level build + host leaf-wise replay; falls back to
+        the sequential leaf-wise builder when speculation was too shallow
+        for an exact replay."""
+        from .level_builder import replay_leafwise
+        spec = self._level_fn()(self.words_dev, grad, hess,
+                                self._fmask_arr(feature_mask))
+        host = jax.device_get(spec._replace(rid=None))
+        rec, exact = replay_leafwise(host, self.cfg.num_leaves)
+        if not exact:
+            self._level_fallbacks = getattr(self, "_level_fallbacks", 0) + 1
+            return None
+        rec = rec._replace(block_begin=spec.block_begin,
+                           block_cnt=spec.block_cnt)
+        return spec.rid, rec
+
     @property
     def bins_T_dev(self) -> jax.Array:
         """Transposed bins [F, N] so a dynamic feature's column is one
@@ -234,7 +338,16 @@ class DeviceTreeLearner:
         irregular step is ONE key-sort back to row order — no per-level tree
         traversal. One fused program, score buffer donated. (Replaces the
         reference's Tree::AddPredictionToScore bulk update,
-        tree.cpp:112-204.) Valid only for full-data (no bagging) trees."""
+        tree.cpp:112-204.) Valid only for full-data (no bagging) trees.
+
+        Level-built records carry a FINER physical partition than the
+        committed tree: score through the block tables instead."""
+        if record.block_begin is not None:
+            return _partition_score_update(
+                score, jnp.int32(class_id), jnp.asarray(record.block_begin),
+                jnp.asarray(record.block_cnt),
+                jnp.asarray(record.block_value, dtype=jnp.float32), indices,
+                jnp.int32(self.n), jnp.float32(scale))
         return _partition_score_update(
             score, jnp.int32(class_id), record.leaf_begin,
             record.leaf_cnt_part, record.leaf_value, indices,
@@ -257,22 +370,16 @@ class DeviceTreeLearner:
 
     # ------------------------------------------------------------------
     def _buckets_for(self, root_count: int) -> List[int]:
-        sizes = []
-        s = self.min_pad
-        top = max(_pow2ceil(root_count), self.min_pad)
-        while s <= top:
-            sizes.append(s)
-            s <<= 1
-        return sizes
+        return bucket_table(self.min_pad, root_count)
 
-    def _bucket_index(self, count, n_buckets: int):
-        """Smallest bucket with min_pad << b >= count — exact integer
-        comparison against the bucket-size table (float log2 would undercount
-        near 2^24 and silently drop rows)."""
-        sizes = jnp.asarray([self.min_pad << b for b in range(n_buckets)],
-                            jnp.int32)
+    @staticmethod
+    def _bucket_index(count, sizes_list):
+        """Smallest bucket size >= count — exact integer comparison against
+        the bucket-size table (float log2 would undercount near 2^24 and
+        silently drop rows)."""
+        sizes = jnp.asarray(sizes_list, jnp.int32)
         b = jnp.sum((count > sizes).astype(jnp.int32))
-        return jnp.clip(b, 0, n_buckets - 1)
+        return jnp.clip(b, 0, len(sizes_list) - 1)
 
     # ------------------------------------------------------------------
     def _make_build_fn(self, root_padded: int, root_contiguous: bool):
@@ -412,27 +519,7 @@ class DeviceTreeLearner:
                 return jnp.where(depth >= depth_limit,
                                  jnp.full_like(gain, NEG_INF), gain)
 
-            def _payload(out, gain):
-                """Pack the winning feature's split into (vecF, vecI, bits)."""
-                f = jnp.argmax(gain)
-                vecF = jnp.zeros(BF_W, jnp.float32)
-                vecF = vecF.at[BF_GAIN].set(gain[f])
-                vecF = vecF.at[BF_LG].set(out["left_g"][f])
-                vecF = vecF.at[BF_LH].set(out["left_h"][f])
-                vecF = vecF.at[BF_RG].set(out["right_g"][f])
-                vecF = vecF.at[BF_RH].set(out["right_h"][f])
-                vecF = vecF.at[BF_LOUT].set(out["left_output"][f])
-                vecF = vecF.at[BF_ROUT].set(out["right_output"][f])
-                vecI = jnp.zeros(BI_W, jnp.int32)
-                vecI = vecI.at[BI_FEAT].set(f.astype(jnp.int32))
-                vecI = vecI.at[BI_THR].set(out["threshold"][f])
-                vecI = vecI.at[BI_LC].set(out["left_c"][f])
-                vecI = vecI.at[BI_RC].set(out["right_c"][f])
-                vecI = vecI.at[BI_DEFLEFT].set(
-                    out["default_left"][f].astype(jnp.int32))
-                vecI = vecI.at[BI_ISCAT].set(
-                    out["is_cat"][f].astype(jnp.int32))
-                return vecF, vecI, out["cat_bitset"][f]
+            _payload = pack_best_payload
 
             if mode == "voting":
                 # PV-Tree (reference voting_parallel_tree_learner.cpp:
@@ -480,7 +567,7 @@ class DeviceTreeLearner:
                 sums = jnp.sum(jnp.where(valid[:, None], gh0, 0.0), axis=0)
                 root_g, root_h = sums[0], sums[1]
             else:
-                bsel = self._bucket_index(root_count, nbk)
+                bsel = self._bucket_index(root_count, buckets)
                 root_hist = lax.switch(
                     bsel, hist_fns, bins, indices, gh, jnp.int32(0),
                     root_count)
@@ -551,7 +638,7 @@ class DeviceTreeLearner:
                 # contiguous column read from the transposed bins
                 bins_col = lax.dynamic_slice(
                     bins_T, (f, jnp.int32(0)), (1, bins_T.shape[1]))[0]
-                bk = self._bucket_index(count, nbk)
+                bk = self._bucket_index(count, buckets)
                 new_indices, left_cnt = lax.switch(
                     bk, part_fns, bins_col, indices, begin, count, thr,
                     dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bB)
@@ -616,7 +703,7 @@ class DeviceTreeLearner:
                 sm_begin = jnp.where(smaller_is_left, begin,
                                      begin + left_cnt)
                 sm_count = jnp.where(smaller_is_left, left_cnt, right_cnt)
-                bk2 = self._bucket_index(sm_count, nbk)
+                bk2 = self._bucket_index(sm_count, buckets)
                 sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
                                      gh, sm_begin, sm_count)
                 sm_hist = _gsum_hist(sm_hist)
@@ -706,6 +793,10 @@ class DeviceTreeLearner:
         """Grow one tree on the full data with a fresh identity partition
         (created inside the program — fewer dispatches, contiguous root
         histogram)."""
+        if self.level_mode_ok():
+            out = self._level_train_fresh(grad, hess, feature_mask)
+            if out is not None:
+                return out
         root_padded = max(_pow2ceil(self.n), self.min_pad)
         key = (root_padded, True)
         fn = self._build_cache.get(key)
@@ -726,6 +817,11 @@ class DeviceTreeLearner:
 
         Returns (new_score [K,N], indices, record).
         """
+        if self.level_mode_ok():
+            out = self._level_iter_fused(score, objective, scale,
+                                         feature_mask)
+            if out is not None:
+                return out
         root_padded = max(_pow2ceil(self.n), self.min_pad)
         key = (root_padded, "iter_fused", id(objective))
         fn = self._build_cache.get(key)
@@ -738,13 +834,45 @@ class DeviceTreeLearner:
                 indices, rec = build(self.bins_dev, self.bins_T_dev,
                                      gdev[0], hdev[0], fmask)
                 new_score = _partition_score_update(
-                    score, jnp.int32(0), rec.leaf_begin, rec.leaf_cnt_part,
-                    rec.leaf_value, indices, jnp.int32(self.n), scale)
+                    score, jnp.int32(0), rec.leaf_begin,
+                    rec.leaf_cnt_part, rec.leaf_value, indices,
+                    jnp.int32(self.n), scale)
                 return new_score, indices, rec
 
             fn = jax.jit(step, donate_argnums=(0,))
             self._build_cache[key] = fn
         return fn(score, jnp.float32(scale), self._fmask_arr(feature_mask))
+
+    def _level_iter_fused(self, score, objective, scale, feature_mask):
+        """Level-mode iteration: program A traces gradients + speculative
+        build; the leaf-wise replay runs on host; program B applies the
+        block score update. Returns None when the replay was inexact (the
+        caller then runs the sequential leaf-wise fused path)."""
+        from .level_builder import replay_leafwise
+        key = ("level_iterA", id(objective))
+        fnA = self._build_cache.get(key)
+        if fnA is None:
+            level = self._level_fn()
+
+            def stepA(score, fmask):
+                gdev, hdev = objective.gradients_impl(score)
+                return level(self.words_dev, gdev[0], hdev[0], fmask)
+
+            fnA = jax.jit(stepA)
+            self._build_cache[key] = fnA
+        spec = fnA(score, self._fmask_arr(feature_mask))
+        host = jax.device_get(spec._replace(rid=None))
+        rec, exact = replay_leafwise(host, self.cfg.num_leaves)
+        if not exact:
+            self._level_fallbacks = getattr(self, "_level_fallbacks", 0) + 1
+            return None
+        rec = rec._replace(block_begin=spec.block_begin,
+                           block_cnt=spec.block_cnt)
+        new_score = _partition_score_update(
+            score, jnp.int32(0), spec.block_begin, spec.block_cnt,
+            jnp.asarray(rec.block_value, jnp.float32), spec.rid,
+            jnp.int32(self.n), jnp.float32(scale))
+        return new_score, spec.rid, rec
 
     # ------------------------------------------------------------------
     def record_to_tree(self, rec_host, shrinkage: float = 1.0) -> Tree:

@@ -80,7 +80,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.lgbt_predict.argtypes = [
             pf64, c.c_int64, c.c_int64, c.c_int32, p64, p64, p32, p32, p32,
             pf64, p8, pf64, p64, p32, p64, pu32, p32, p32, c.c_int32,
-            c.c_int32, pf64]
+            c.c_int32, c.c_int32, c.c_double, pf64]
     except AttributeError:
         pass
     _lib = lib
@@ -192,7 +192,8 @@ def bin_matrix(data: np.ndarray, col_idx: np.ndarray, bin_type: np.ndarray,
 
 
 def predict_forest(X: np.ndarray, flat: dict, num_class: int,
-                   pred_leaf: bool = False) -> Optional[np.ndarray]:
+                   pred_leaf: bool = False, early_stop_freq: int = 0,
+                   early_stop_margin: float = 0.0) -> Optional[np.ndarray]:
     """Batch raw prediction over a flattened forest (predictor.cpp),
     OpenMP over rows; None when the native library is unavailable.
     `flat` is `ops.predict.flatten_forest(trees)`."""
@@ -222,8 +223,8 @@ def predict_forest(X: np.ndarray, flat: dict, num_class: int,
         _ptr(flat["cat_words"], ctypes.c_uint32),
         _ptr(flat["num_leaves"], ctypes.c_int32),
         _ptr(flat["tree_class"], ctypes.c_int32),
-        num_class, 1 if pred_leaf else 0,
-        _ptr(out, ctypes.c_double))
+        num_class, 1 if pred_leaf else 0, int(early_stop_freq),
+        float(early_stop_margin), _ptr(out, ctypes.c_double))
     if rc != 0:
         return None
     return out if pred_leaf or num_class > 1 else out[:, 0]

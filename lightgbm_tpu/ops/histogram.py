@@ -145,3 +145,32 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """larger-child = parent − smaller-child (reference
     `FeatureHistogram::Subtract`, `feature_histogram.hpp:75`)."""
     return parent - child
+
+
+def histogram_from_words(words, g: jax.Array, h: jax.Array,
+                         valid: jax.Array, num_features: int, max_bin: int,
+                         chunk: int = 1 << 16,
+                         precision: str = "bf16x2") -> jax.Array:
+    """Histogram over PACKED bin words (level builder record layout:
+    4 uint8 bins per int32, word w bits 8j..8j+7 = feature 4w+j).
+
+    words: list of int32 [P] (ceil(F/4) arrays); g/h: f32 [P];
+    valid: bool [P]. Returns f32 [F, max_bin, 3].
+
+    On TPU this runs as a Pallas kernel that unpacks the words in VMEM
+    (contiguous lane-oriented reads — the replacement for the leaf-wise
+    path's random row gather); elsewhere the words are unpacked in XLA and
+    the einsum path is reused.
+    """
+    if precision == "pallas":
+        from .pallas_hist import pallas_histogram_words
+        return pallas_histogram_words(words, g, h, valid, num_features,
+                                      max_bin)
+    cols = []
+    for f in range(num_features):
+        w = words[f >> 2]
+        cols.append((w >> ((f & 3) * 8)) & 255)
+    bins = jnp.stack(cols, axis=1)
+    gh = jnp.stack([g, h], axis=1)
+    return histogram_from_gathered_gh(bins, gh, valid, max_bin, chunk,
+                                      precision)

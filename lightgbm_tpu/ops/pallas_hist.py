@@ -43,14 +43,18 @@ NUM_STATS = 3  # grad, hess, count
 def _hist_kernel(bins_ref, pay_ref, out_ref, *, num_features: int,
                  max_bin: int, payload_width: int):
     """One grid step: accumulate a row-chunk into the VMEM-resident
-    histogram. bins_ref [C, F] int32; pay_ref [C, W]; out_ref [F, B, W]."""
+    histogram. bins_ref [C, F] uint8; pay_ref [C, W]; out_ref [F, B, W].
+
+    Invalid rows need no bin masking: their payload columns (g, h, count)
+    are all zero, so whatever bin they land in receives zeros.
+    """
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[...]
+    bins = bins_ref[...].astype(jnp.int32)
     pay_f32 = pay_ref[...]                      # [C, 3] f32 (g, h, cnt)
     # hi/lo bf16 split INSIDE the kernel: done outside, XLA's algebraic
     # simplifier cancels the f32->bf16->f32 round-trip and silently drops
@@ -72,16 +76,18 @@ def _hist_kernel(bins_ref, pay_ref, out_ref, *, num_features: int,
 @functools.partial(jax.jit,
                    static_argnames=("max_bin", "chunk"))
 def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
-                     max_bin: int, chunk: int = 1 << 9) -> jax.Array:
+                     max_bin: int, chunk: int = 1 << 11) -> jax.Array:
     """hist[F, max_bin, 3] over contiguous (already gathered) rows.
 
-    bins_rows: uint8/int32 [P, F]; gh: f32 [P, 2]; valid: bool [P].
-    Same contract as `histogram_from_gathered_gh`. P is padded to a chunk
-    multiple; masked rows contribute nothing (payload zeroed and bin forced
-    out of range).
+    bins_rows: uint8 [P, F]; gh: f32 [P, 2]; valid: bool [P].
+    Same contract as `histogram_from_gathered_gh`. The kernel reads the
+    uint8 matrix directly (no int32 copy of the full array — at 10M rows
+    that copy alone quadruples HBM traffic and can OOM); rows are processed
+    in VMEM-sized chunks with the accumulator resident in VMEM.
     """
     p, f = bins_rows.shape
-    bins_i = bins_rows.astype(jnp.int32)
+    if bins_rows.dtype != jnp.uint8:
+        bins_rows = bins_rows.astype(jnp.uint8)
     g = jnp.where(valid, gh[:, 0], 0.0)
     h = jnp.where(valid, gh[:, 1], 0.0)
     cnt = valid.astype(jnp.float32)
@@ -89,11 +95,11 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
     # bin axis padded to a 128-lane multiple: unaligned one-hot tiles force
     # awkward VMEM layouts (scoped-vmem OOM at max_bin=255)
     b_pad = max(128, ((max_bin + 127) // 128) * 128)
-    bins_i = jnp.where(valid[:, None], bins_i, b_pad)  # mask -> out-of-range
     n_chunks = max(1, (p + chunk - 1) // chunk)
     pad = n_chunks * chunk - p
     if pad:
-        bins_i = jnp.pad(bins_i, ((0, pad), (0, 0)), constant_values=b_pad)
+        # pad rows as INVALID (zero payload) — bins may be any in-range value
+        bins_rows = jnp.pad(bins_rows, ((0, pad), (0, 0)))
         pay = jnp.pad(pay, ((0, pad), (0, 0)))
 
     w = 2 * NUM_STATS
@@ -108,8 +114,76 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
         ],
         out_specs=pl.BlockSpec((f, b_pad, w), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((f, b_pad, w), jnp.float32),
-    )(bins_i, pay)
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )(bins_rows, pay)
     # fold the lo-parts back into the hi sums; drop the bin padding
+    return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
+
+
+def _hist_words_kernel(*refs, num_features: int, max_bin: int,
+                       wcnt: int):
+    """Transposed-layout word kernel: per feature, a lane-oriented row
+    slice of the packed words is unpacked with shift/mask (no column
+    relayout), compared against a sublane iota into a [B, C] one-hot, and
+    contracted on the MXU against the [C, 6] hi/lo payload."""
+    word_refs = refs[:wcnt]
+    pay_ref = refs[wcnt]
+    out_ref = refs[wcnt + 1]
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    pay_f32 = pay_ref[...]                       # [C, 3]
+    p_hi = pay_f32.astype(jnp.bfloat16)
+    p_lo = (pay_f32 - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    pay = jnp.concatenate([p_hi, p_lo], axis=1)  # [C, 6]
+    chunk = pay_f32.shape[0]
+    iota = lax.broadcasted_iota(jnp.int32, (max_bin, chunk), 0)
+    for f in range(num_features):
+        w = word_refs[f >> 2][0, :]              # [C] int32, lane-oriented
+        col = (w >> ((f & 3) * 8)) & 255
+        onehot = (col[None, :] == iota).astype(jnp.bfloat16)   # [B, C]
+        contrib = lax.dot_general(onehot, pay, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[f] += contrib                    # [B, 6]
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "max_bin",
+                                             "chunk"))
+def pallas_histogram_words(words, g: jax.Array, h: jax.Array,
+                           valid: jax.Array, num_features: int,
+                           max_bin: int, chunk: int = 1 << 11) -> jax.Array:
+    """hist[F, max_bin, 3] over packed bin words (see
+    `histogram.histogram_from_words` for the layout contract)."""
+    p = g.shape[0]
+    wcnt = len(words)
+    gm = jnp.where(valid, g, 0.0)
+    hm = jnp.where(valid, h, 0.0)
+    pay = jnp.stack([gm, hm, valid.astype(jnp.float32)], axis=1)
+    b_pad = max(128, ((max_bin + 127) // 128) * 128)
+    n_chunks = max(1, (p + chunk - 1) // chunk)
+    pad = n_chunks * chunk - p
+    words2 = [w.reshape(1, p) for w in words]
+    if pad:
+        words2 = [jnp.pad(w, ((0, 0), (0, pad))) for w in words2]
+        pay = jnp.pad(pay, ((0, pad), (0, 0)))
+    kernel = functools.partial(_hist_words_kernel,
+                               num_features=num_features, max_bin=b_pad,
+                               wcnt=wcnt)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[pl.BlockSpec((1, chunk), lambda i: (0, i))
+                  for _ in range(wcnt)]
+        + [pl.BlockSpec((chunk, NUM_STATS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((num_features, b_pad, 6),
+                               lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_features, b_pad, 6),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )(*words2, pay)
     return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
 
 

@@ -255,10 +255,17 @@ class DataParallelTreeLearner:
                                  record: TreeRecord, indices: jax.Array,
                                  scale: float) -> jax.Array:
         """Partition-based score update, per shard: leaf fill over the local
-        partition + one key-sort back to the shard's row-block order."""
-        row = self._partition_score_fn()(
-            score[class_id], record.leaf_begin, record.leaf_cnt_part,
-            record.leaf_value, indices, jnp.float32(scale))
+        partition + one key-sort back to the shard's row-block order.
+        Level-built records score through their (finer) block tables."""
+        if record.block_begin is not None:
+            row = self._partition_score_fn()(
+                score[class_id], record.block_begin, record.block_cnt,
+                jnp.asarray(record.block_value, jnp.float32), indices,
+                jnp.float32(scale))
+        else:
+            row = self._partition_score_fn()(
+                score[class_id], record.leaf_begin, record.leaf_cnt_part,
+                record.leaf_value, indices, jnp.float32(scale))
         return score.at[class_id].set(row)
 
     # ------------------------------------------------------------------
@@ -272,6 +279,72 @@ class DataParallelTreeLearner:
     def train_fresh(self, grad: jax.Array, hess: jax.Array,
                     feature_mask: Optional[np.ndarray] = None
                     ) -> Tuple[jax.Array, TreeRecord]:
+        if self.inner.level_mode_ok():
+            from ..models.level_builder import replay_leafwise
+            fn = self._sharded_level_fn()
+            spec = fn(self._words_sharded(), grad, hess,
+                      self.inner._fmask_arr(feature_mask))
+            host = jax.device_get(spec._replace(rid=None))
+            # leafI is per-shard [nd*S, w]; global lanes are identical, so
+            # shard 0's slice serves the replay
+            S = host.bestF.shape[0]
+            host = host._replace(leafI=host.leafI[:S],
+                                 block_begin=host.block_begin[:S],
+                                 block_cnt=host.block_cnt[:S])
+            rec, exact = replay_leafwise(host, self.cfg.num_leaves)
+            if exact:
+                rec = rec._replace(block_begin=spec.block_begin,
+                                   block_cnt=spec.block_cnt)
+                return spec.rid, rec
+            self.inner._level_fallbacks = getattr(
+                self.inner, "_level_fallbacks", 0) + 1
         fn = self._sharded_train_fn(True)
         return fn(self.bins_sharded, self.bins_T_sharded, grad, hess,
                   self.inner._fmask_arr(feature_mask))
+
+    # ------------------------------------------------------------------
+    def _words_sharded(self) -> jax.Array:
+        w = self._fn_cache.get("words")
+        if w is None:
+            from ..models.level_builder import pack_bin_words
+            bins_np = np.asarray(self.ds.bins)
+            if self.inner.num_features != self.inner.num_real_features:
+                pad_f = self.inner.num_features - self.inner.num_real_features
+                bins_np = np.pad(bins_np, ((0, 0), (0, pad_f)))
+            if self.pad_rows:
+                bins_np = np.pad(bins_np, ((0, self.pad_rows), (0, 0)))
+            w = jax.device_put(
+                pack_bin_words(bins_np),
+                NamedSharding(self.mesh, P(None, self.axis_name)))
+            self._fn_cache["words"] = w
+        return w
+
+    def _sharded_level_fn(self):
+        fn = self._fn_cache.get("level")
+        if fn is not None:
+            return fn
+        from ..models.level_builder import SpecResult, make_level_build_fn
+        build = make_level_build_fn(self.inner)
+        ax = self.axis_name
+        # split decisions are identical on every shard (global histograms);
+        # only the physical partition state is shard-local
+        spec_specs = SpecResult(
+            rid=P(ax), n_exec=P(), execF=P(), execI=P(), execB=P(),
+            bestF=P(), bestI=P(), bestB=P(), leafF=P(), leafI=P(ax),
+            block_begin=P(ax), block_cnt=P(ax))
+        mapped = jax.shard_map(
+            build, mesh=self.mesh,
+            in_specs=(P(None, ax), P(ax), P(ax), P()),
+            out_specs=spec_specs,
+            check_vma=False)
+
+        def run(words, grad, hess, fmask):
+            pad = self.nd * self.per_shard - grad.shape[0]
+            if pad:
+                grad = jnp.pad(grad, (0, pad))
+                hess = jnp.pad(hess, (0, pad))
+            return mapped(words, grad, hess, fmask)
+
+        fn = jax.jit(run)
+        self._fn_cache["level"] = fn
+        return fn

@@ -93,6 +93,10 @@ extern "C" {
 //   tree_class   [T] class slot of each tree (0 for single-class)
 //   mode         0: out[n, num_class] += leaf values (raw score)
 //                1: out[n, T] = leaf index per tree (pred_leaf)
+//   es_freq/es_margin: prediction early stopping (reference
+//     prediction_early_stop.cpp): every es_freq trees, stop the row when
+//     the margin test passes — binary (num_class==1): |sum| > margin;
+//     multiclass: top1 - top2 > margin. es_freq <= 0 disables.
 // out must be zero-initialized by the caller for mode 0.
 int32_t lgbt_predict(const double* X, int64_t n, int64_t num_feat,
                      int32_t num_trees, const int64_t* node_off,
@@ -103,7 +107,8 @@ int32_t lgbt_predict(const double* X, int64_t n, int64_t num_feat,
                      const int32_t* cat_boundaries,
                      const int64_t* cat_words_off, const uint32_t* cat_words,
                      const int32_t* num_leaves, const int32_t* tree_class,
-                     int32_t num_class, int32_t mode, double* out) {
+                     int32_t num_class, int32_t mode, int32_t es_freq,
+                     double es_margin, double* out) {
   Forest f{node_off, leaf_off, left, right, feat, thresh, dtype,
            leaf_value, cat_bnd_off, cat_boundaries, cat_words_off,
            cat_words};
@@ -119,6 +124,18 @@ int32_t lgbt_predict(const double* X, int64_t n, int64_t num_feat,
         orow[t] = leaf;
       } else {
         orow[tree_class[t]] += leaf_value[leaf_off[t] + leaf];
+        if (es_freq > 0 && (t + 1) % es_freq == 0 && t + 1 < num_trees) {
+          if (num_class <= 1) {
+            if (orow[0] > es_margin || -orow[0] > es_margin) break;
+          } else {
+            double top1 = orow[0], top2 = -1e300;
+            for (int32_t c = 1; c < num_class; ++c) {
+              if (orow[c] > top1) { top2 = top1; top1 = orow[c]; }
+              else if (orow[c] > top2) top2 = orow[c];
+            }
+            if (top1 - top2 > es_margin) break;
+          }
+        }
       }
     }
   }

@@ -298,3 +298,109 @@ def test_objectives_smoke():
         bst = lgb.train(params, lgb.Dataset(X, yy), 5, verbose_eval=False)
         pred = bst.predict(X)
         assert np.all(np.isfinite(pred)), obj
+
+
+def test_add_features_from():
+    """Column-wise dataset merge (reference test_basic.py:96-219 /
+    Dataset::AddFeaturesFrom)."""
+    rng = np.random.RandomState(11)
+    n = 2000
+    X1 = rng.randn(n, 4)
+    X2 = rng.randn(n, 3)
+    y = (X1[:, 0] + X2[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    d1 = lgb.Dataset(X1, label=y, params=params,
+                     feature_name=[f"a{i}" for i in range(4)]).construct()
+    d2 = lgb.Dataset(X2, params=params,
+                     feature_name=[f"b{i}" for i in range(3)]).construct()
+    d1.add_features_from(d2)
+    assert d1.num_feature == 7
+    booster = lgb.train(params, d1, num_boost_round=20)
+    # the merged features must actually be usable for splits
+    pred = booster.predict(np.hstack([X1, X2]))
+    acc = ((pred > 0.5) == y).mean()
+    assert acc > 0.85
+    assert booster.feature_name() == [f"a{i}" for i in range(4)] + \
+        [f"b{i}" for i in range(3)]
+    used = set(
+        t.split_feature[i] for t in booster.trees
+        for i in range(t.num_leaves - 1))
+    assert any(fi >= 4 for fi in used), "merged features never split on"
+
+
+def test_add_features_from_row_mismatch():
+    rng = np.random.RandomState(1)
+    d1 = lgb.Dataset(rng.randn(100, 2), label=np.zeros(100)).construct()
+    d2 = lgb.Dataset(rng.randn(99, 2)).construct()
+    with pytest.raises(Exception):
+        d1.add_features_from(d2)
+
+
+def test_pandas_dataframe_with_categoricals():
+    """pandas input: category dtypes auto-detected, codes fed as categorical
+    features, column names become feature names (reference
+    basic.py:255-298, test_engine.py:611+)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(7)
+    n = 3000
+    df = pd.DataFrame({
+        "num_a": rng.randn(n),
+        "num_b": rng.randn(n),
+        "cat_c": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+    })
+    y = ((df["num_a"] > 0) ^ (df["cat_c"] == "z")).astype(float)
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, num_boost_round=30)
+    assert bst.feature_name() == ["num_a", "num_b", "cat_c"]
+    pred = bst.predict(df)
+    acc = ((pred > 0.5) == y.to_numpy()).mean()
+    assert acc > 0.9, acc
+    # the categorical column must be split categorically (decision_type
+    # bit 0), which a numeric treatment of codes would not produce
+    assert any(t.node_is_categorical(s) and t.split_feature[s] == 2
+               for t in bst.trees for s in range(t.num_leaves - 1))
+
+
+def test_all_metrics_matrix():
+    """Every metric evaluates under a compatible objective (reference
+    test_engine.py:936 all-metrics test)."""
+    rng = np.random.RandomState(3)
+    n = 600
+    X = rng.randn(n, 5)
+    cases = {
+        "regression": (np.abs(X[:, 0]) + 0.1 * rng.rand(n) + 0.1,
+                       ["l1", "l2", "rmse", "quantile", "huber", "fair",
+                        "poisson", "mape", "gamma", "gamma_deviance",
+                        "tweedie"]),
+        "binary": ((X[:, 0] > 0).astype(float),
+                   ["binary_logloss", "binary_error", "auc"]),
+        "multiclass": ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0),
+                       ["multi_logloss", "multi_error"]),
+        "xentropy": (rng.rand(n), ["xentropy", "xentlambda", "kldiv"]),
+    }
+    for objective, (y, metrics) in cases.items():
+        params = {"objective": objective, "metric": metrics, "verbose": -1,
+                  "num_leaves": 7}
+        if objective == "multiclass":
+            params["num_class"] = 3
+        evals = {}
+        ds = lgb.Dataset(X, label=y, params=params)
+        lgb.train(params, ds, num_boost_round=3,
+                  valid_sets=[ds], valid_names=["train"],
+                  evals_result=evals, callbacks=[])
+        for m in metrics:
+            assert m in evals["train"], (objective, m, list(evals))
+            assert np.isfinite(evals["train"][m]).all()
+    # rank metrics need queries
+    nq, qsize = 30, 20
+    Xr = rng.randn(nq * qsize, 5)
+    yr = rng.randint(0, 3, nq * qsize)
+    params = {"objective": "lambdarank", "metric": ["ndcg", "map"],
+              "eval_at": [3, 5], "verbose": -1, "num_leaves": 7}
+    ds = lgb.Dataset(Xr, label=yr, group=[qsize] * nq, params=params)
+    evals = {}
+    lgb.train(params, ds, num_boost_round=3, valid_sets=[ds],
+              valid_names=["train"], evals_result=evals)
+    assert any(k.startswith("ndcg") for k in evals["train"])
+    assert any(k.startswith("map") for k in evals["train"])

@@ -235,3 +235,37 @@ def test_native_predictor_multiclass():
         cls_trees = [t for i, t in enumerate(trees) if i % k == cls]
         oracle[:, cls] = predict_raw_values(cls_trees, X)
     np.testing.assert_allclose(out, oracle, rtol=0, atol=0)
+
+
+def test_prediction_early_stop():
+    """Prediction early stopping (reference prediction_early_stop.cpp):
+    margin-passed rows stop accumulating trees; native path and the
+    pure-Python walk must agree exactly."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import _early_stop_predict_py
+    rng = np.random.RandomState(9)
+    n = 1500
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "learning_rate": 0.3}, ds,
+                    num_boost_round=40)
+    p_full = bst.predict(X)
+    p_es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                       pred_early_stop_margin=1.0)
+    # margin 1.0 truncates confident rows: predictions differ but classes
+    # agree almost everywhere
+    assert not np.allclose(p_es, p_full)
+    assert ((p_es > 0.5) == (p_full > 0.5)).mean() > 0.98
+    # huge margin -> identical to the full walk
+    p_inf = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                        pred_early_stop_margin=1e12)
+    np.testing.assert_allclose(p_inf, p_full)
+    # native vs python fallback agreement (raw accumulations)
+    raw_py = _early_stop_predict_py(bst.trees, X, 1, 5, 1.0)[:, 0]
+    from lightgbm_tpu.native import predict_forest
+    from lightgbm_tpu.ops.predict import flatten_forest
+    raw_nat = predict_forest(X, flatten_forest(bst.trees, 1), 1,
+                             early_stop_freq=5, early_stop_margin=1.0)
+    np.testing.assert_allclose(raw_nat, raw_py)

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Per-phase TPU timings for the tree-build hot path.
+
+Times each device program of one boosting iteration separately (sync via a
+1-element device pull, like bench.py) so optimization work targets the real
+bottleneck. Run on the real chip:  python tools/profile_tpu.py [N]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import Dataset  # noqa: E402
+from lightgbm_tpu.models.device_learner import DeviceTreeLearner  # noqa: E402
+from lightgbm_tpu.ops.histogram import histogram_from_gathered_gh  # noqa: E402
+from lightgbm_tpu.ops.partition import split_partition  # noqa: E402
+
+
+def sync(x):
+    np.asarray(jax.device_get(x.reshape(-1)[:1]))
+
+
+def timeit(fn, *args, reps=3, warm=1):
+    for _ in range(warm):
+        out = fn(*args)
+    sync(out if isinstance(out, jax.Array) else jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out if isinstance(out, jax.Array) else jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+    f = 28
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, f), dtype=np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "min_data_in_leaf": 20, "verbosity": -1, "metric": "none"}
+    cfg = Config.from_params(params)
+    t0 = time.perf_counter()
+    ds = Dataset.from_matrix(X, label=y, config=cfg)
+    print(f"bin(native): {time.perf_counter() - t0:.2f}s")
+
+    learner = DeviceTreeLearner(cfg, ds)
+    bins = learner.bins_dev
+    bins_T = learner.bins_T_dev
+    grad = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    hess = jnp.ones(n, jnp.float32)
+    gh = jnp.stack([grad, hess], axis=1)
+    sync(bins)
+
+    # 1) root histogram, contiguous rows
+    valid = jnp.ones(n, bool)
+    for prec in ("bf16x2", "pallas"):
+        try:
+            t = timeit(lambda: histogram_from_gathered_gh(
+                bins, gh, valid, 256, int(cfg.tpu_hist_chunk), prec))
+            print(f"root hist {prec:7s}: {t*1e3:8.1f} ms")
+        except Exception as e:
+            print(f"root hist {prec}: FAILED {type(e).__name__}: {e}")
+
+    # 2) random gather of rows (the per-leaf gather) at several sizes
+    for sz in (1 << 20, 1 << 22, 1 << 23):
+        if sz > n:
+            continue
+        idx = jnp.asarray(rng.integers(0, n, sz), jnp.int32)
+
+        gath = jax.jit(lambda b, g, i: (b[i], g[i]))
+        t = timeit(gath, bins, gh, idx)
+        print(f"gather rows+gh {sz>>20:3d}M: {t*1e3:8.1f} ms "
+              f"({t/sz*1e9:.1f} ns/row)")
+
+    # 3) sort partition at several padded sizes
+    n_pad = n + max(1 << (n - 1).bit_length(), 1024)
+    indices = jnp.arange(n_pad, dtype=jnp.int32) % n
+    col = bins_T[0]
+    for sz in (1 << 21, 1 << 23, 1 << 24):
+        if sz > n_pad:
+            continue
+        t = timeit(lambda s=sz: split_partition(
+            indices, col, jnp.int32(0), jnp.int32(s - 7), s,
+            jnp.int32(100), jnp.bool_(False), jnp.int32(0), jnp.int32(0),
+            jnp.int32(255), jnp.bool_(False), jnp.zeros(8, jnp.uint32)))
+        print(f"sort-partition {sz>>20:3d}M: {t*1e3:8.1f} ms "
+              f"({t/sz*1e9:.1f} ns/row)")
+
+    # 4) whole-tree build (fresh identity partition)
+    fmask = jnp.ones(ds.num_features, jnp.float32)
+    t = timeit(lambda: learner.train_fresh(grad, hess)[1].leaf_value, reps=2)
+    print(f"whole tree 255 leaves: {t*1e3:8.1f} ms")
+
+    # 5) per-split fixed overhead: same leaves on tiny data
+    n2 = 200_000
+    ds2 = Dataset.from_matrix(X[:n2], label=y[:n2], config=cfg)
+    l2 = DeviceTreeLearner(cfg, ds2)
+    g2, h2 = grad[:n2], hess[:n2]
+    t = timeit(lambda: l2.train_fresh(g2, h2)[1].leaf_value, reps=2)
+    print(f"whole tree 255 leaves (200k rows): {t*1e3:8.1f} ms")
+
+    # 6) full boosting iteration via the public path
+    train = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=train)
+    bst.update()
+    sync(bst._gbdt.train_score.score)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        bst.update()
+    sync(bst._gbdt.train_score.score)
+    print(f"full iteration (unfused): {(time.perf_counter()-t0)/3*1e3:8.1f} ms")
+
+    params2 = dict(params, tpu_fuse_iteration=True)
+    train2 = lgb.Dataset(X, label=y, params=params2).construct()
+    bst2 = lgb.Booster(params=params2, train_set=train2)
+    bst2.update()
+    sync(bst2._gbdt.train_score.score)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        bst2.update()
+    sync(bst2._gbdt.train_score.score)
+    print(f"full iteration (fused):   {(time.perf_counter()-t0)/3*1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
