@@ -1,0 +1,118 @@
+"""Primitive-cost microbenchmarks on the attached TPU.
+
+Measures the building blocks the tree builders are assembled from so
+optimization is evidence-driven (VERDICT r1 item #1c). Run directly:
+    python tools/microbench.py [N]
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+F = 28
+REPS = 5
+
+
+def _sync(out):
+    """Force queued device work to finish (block_until_ready is a no-op on
+    the tunneled runtime): pull 4 bytes of the first leaf."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def timeit(name, fn, *args, reps=REPS):
+    _sync(fn(*args))  # compile + warm
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:55s} {dt*1e3:9.2f} ms   {dt/N*1e9:7.2f} ns/row",
+          flush=True)
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, 255, size=(N, F), dtype=np.uint8)
+    bins = jnp.asarray(bins_np)
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+    h = jnp.ones(N, jnp.float32)
+    gh = jnp.stack([g, h], axis=1)
+    valid = jnp.ones(N, bool)
+    idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+    idx_half = idx[: N // 2]
+
+    print(f"N={N} F={F} device={jax.devices()[0]}")
+
+    # --- histograms
+    from lightgbm_tpu.ops.histogram import histogram_from_gathered_gh
+    from lightgbm_tpu.ops.pallas_hist import pallas_histogram
+
+    for B in (256, 64):
+        timeit(f"einsum hist bf16x2 B={B} (full N)",
+               jax.jit(lambda b, p, v: histogram_from_gathered_gh(
+                   b, p, v, B, 1 << 13, "bf16x2")), bins, gh, valid)
+        for chunk in (1 << 11, 1 << 13, 1 << 15):
+            timeit(f"pallas hist B={B} chunk={chunk} (full N)",
+                   jax.jit(functools.partial(pallas_histogram, max_bin=B,
+                                             chunk=chunk)), bins, gh, valid)
+
+    # --- packed-words pallas hist
+    from lightgbm_tpu.models.level_builder import pack_bin_words
+    from lightgbm_tpu.ops.pallas_hist import pallas_histogram_words
+    words_np = pack_bin_words(bins_np)
+    words = [jnp.asarray(words_np[i]) for i in range(words_np.shape[0])]
+    for B in (256, 64):
+        timeit(f"pallas words hist B={B} (full N)",
+               jax.jit(functools.partial(pallas_histogram_words,
+                                         num_features=F, max_bin=B)),
+               words, g, h, valid)
+
+    # --- gathers
+    timeit("gather rows bins[idx] N/2 uint8[.,28]",
+           jax.jit(lambda b, i: b[i]), bins, idx_half)
+    timeit("gather gh[idx] N/2 f32[.,2]",
+           jax.jit(lambda b, i: b[i]), gh, idx_half)
+    timeit("gather f32 scalar col g[idx] N/2",
+           jax.jit(lambda b, i: b[i]), g, idx_half)
+    timeit("take small-table t[leaf] (256-entry, full N)",
+           jax.jit(lambda t, i: t[i]),
+           jnp.arange(256, dtype=jnp.int32), jnp.asarray(
+               rng.randint(0, 256, N).astype(np.int32)))
+
+    # --- scatter
+    timeit("scatter-add f32 zeros[N].at[idx].add(g) (full N)",
+           jax.jit(lambda i, v: jnp.zeros(N, jnp.float32).at[i].add(v)),
+           idx, g)
+
+    # --- sorts
+    key = jnp.asarray(rng.randint(0, 512, N).astype(np.int32))
+    rid = jnp.arange(N, dtype=jnp.int32)
+    timeit("sort 2-op (key, rid)",
+           jax.jit(lambda k, r: lax.sort([k, r], num_keys=1,
+                                         is_stable=True)), key, rid)
+    ops11 = [key] + [jnp.asarray(words_np[i]) for i in range(7)] + [g, h, rid]
+    timeit("sort 11-op (key + 7 words + g,h,rid)",
+           jax.jit(lambda *a: lax.sort(list(a), num_keys=1,
+                                       is_stable=True)), *ops11)
+
+    # --- cumsum / elementwise
+    timeit("cumsum i32 full N", jax.jit(lambda x: jnp.cumsum(x)),
+           key)
+    timeit("elementwise route (compare+select, full N)",
+           jax.jit(lambda b, t: (b[:, 0] <= t[0]).astype(jnp.int32)),
+           bins, jnp.arange(F, dtype=jnp.uint8))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Round-2 focused microbench: v2 hist kernel + partition primitives."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+F = 28
+REPS = 5
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def timeit(name, fn, *args, reps=REPS):
+    _sync(fn(*args))
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:55s} {dt*1e3:9.2f} ms   {dt/N*1e9:7.2f} ns/row",
+          flush=True)
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, 255, size=(N, F), dtype=np.uint8)
+    g_np = rng.randn(N).astype(np.float32)
+    g = jnp.asarray(g_np)
+    h = jnp.ones(N, jnp.float32)
+    print(f"N={N} F={F} device={jax.devices()[0]}", flush=True)
+
+    from lightgbm_tpu.ops.pallas_hist2 import (hist2_words,
+                                               pack_words_rowmajor)
+    words_rm_np = pack_words_rowmajor(bins_np)
+    words_rm = jnp.asarray(words_rm_np)
+    payT = jnp.stack([g, h, jnp.ones(N, jnp.float32)])
+
+    # correctness vs numpy on a small slice
+    M = 100_000
+    small = hist2_words(words_rm[:M], payT[:, :M], F, 255, 512)
+    ref = np.zeros((F, 255, 3))
+    for f in range(F):
+        np.add.at(ref[f, :, 0], bins_np[:M, f], g_np[:M])
+        np.add.at(ref[f, :, 1], bins_np[:M, f], 1.0)
+        np.add.at(ref[f, :, 2], bins_np[:M, f], 1.0)
+    err = np.abs(np.asarray(small) - ref).max() / max(1, np.abs(ref).max())
+    print(f"hist2 correctness rel err: {err:.2e}", flush=True)
+
+    for B in (256, 64):
+        for chunk in (512, 1024, 2048):
+            timeit(f"hist2 words B={B} chunk={chunk} (full N)",
+                   functools.partial(hist2_words, num_features=F,
+                                     max_bin=B, chunk=chunk),
+                   words_rm, payT)
+
+    # --- sorts
+    key = jnp.asarray(rng.randint(0, 512, N).astype(np.int32))
+    rid = jnp.arange(N, dtype=jnp.int32)
+    timeit("sort 2-op (key, rid)",
+           jax.jit(lambda k, r: lax.sort([k, r], num_keys=1,
+                                         is_stable=True)), key, rid)
+    wcols = [jnp.asarray(words_rm_np[:, i]) for i in range(7)]
+    ops11 = [key] + wcols + [g, h, rid]
+    timeit("sort 11-op (key + 7 words + g,h,rid)",
+           jax.jit(lambda *a: lax.sort(list(a), num_keys=1,
+                                       is_stable=True)), *ops11)
+
+    # --- gathers / scatters
+    idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+    idx_half = idx[: N // 2]
+    bins = jnp.asarray(bins_np)
+    timeit("gather rows bins[idx] N/2 uint8[.,28]",
+           jax.jit(lambda b, i: b[i]), bins, idx_half)
+    timeit("gather words_rm[idx] N/2 i32[.,7]",
+           jax.jit(lambda b, i: b[i]), words_rm, idx_half)
+    timeit("gather f32 g[idx] full N (permutation)",
+           jax.jit(lambda b, i: b[i]), g, idx)
+    timeit("scatter f32 perm zeros[N].at[idx].set(g)",
+           jax.jit(lambda i, v: jnp.zeros(N, jnp.float32).at[i].set(v)),
+           idx, g)
+    timeit("take small-table t[leaf] (1024-entry, full N)",
+           jax.jit(lambda t, i: t[i]),
+           jnp.arange(1024, dtype=jnp.int32),
+           jnp.asarray(rng.randint(0, 1024, N).astype(np.int32)))
+    timeit("cumsum i32 full N", jax.jit(lambda x: jnp.cumsum(x)), key)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Dispatch overhead + size scaling on the tunneled TPU."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def timeit(name, fn, *args, reps=5):
+    _sync(fn(*args))
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:48s} {dt*1e3:9.3f} ms", flush=True)
+    return dt
+
+
+def main():
+    print(f"device={jax.devices()[0]}", flush=True)
+    x1 = jnp.ones(8, jnp.float32)
+
+    add = jax.jit(lambda x: x + 1.0)
+    _sync(add(x1))
+    # dispatch throughput: 100 queued tiny ops
+    t0 = time.perf_counter()
+    y = x1
+    for _ in range(100):
+        y = add(y)
+    _sync(y)
+    print(f"100 chained tiny ops: {(time.perf_counter()-t0)*1e3:.1f} ms "
+          f"(per-op {(time.perf_counter()-t0)*10:.2f} ms)", flush=True)
+
+    for n in (1_000_000, 4_000_000, 10_500_000, 42_000_000):
+        x = jnp.ones(n, jnp.float32)
+        timeit(f"cumsum f32 n={n}", jax.jit(jnp.cumsum), x)
+    for n in (1_000_000, 10_500_000):
+        x = jnp.ones(n, jnp.float32)
+        timeit(f"x*2+1 elementwise n={n}",
+               jax.jit(lambda v: v * 2 + 1), x)
+    # copy bandwidth
+    for n in (10_500_000, 42_000_000):
+        x = jnp.ones(n, jnp.float32)
+        timeit(f"concat-roll copy n={n}",
+               jax.jit(lambda v: jnp.roll(v, 1)), x)
+    # matmul peak check
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    d = timeit("matmul 4096^3 bf16", jax.jit(
+        lambda m: m @ m), a)
+    print(f"  -> {2*4096**3/d/1e12:.1f} TFLOPS", flush=True)
+    a8 = jnp.ones((8, 4096), jnp.bfloat16)
+    b = jnp.ones((4096, 8192), jnp.bfloat16)
+    d = timeit("matmul [8,4096]x[4096,8192] bf16", jax.jit(
+        lambda x, y: x @ y), a8, b)
+    print(f"  -> {2*8*4096*8192/d/1e12:.2f} TFLOPS (thin)", flush=True)
+
+    # sort scaling
+    for n in (1_000_000, 10_500_000):
+        k = jnp.asarray(np.random.randint(0, 512, n).astype(np.int32))
+        r = jnp.arange(n, dtype=jnp.int32)
+        timeit(f"sort 2-op n={n}",
+               jax.jit(lambda a, b: lax.sort([a, b], num_keys=1,
+                                             is_stable=True)), k, r)
+
+
+if __name__ == "__main__":
+    main()

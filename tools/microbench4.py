@@ -1,0 +1,79 @@
+"""Separate tunnel dispatch/sync overhead from device compute time."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def series(name, fn, x, chained, counts=(1, 5, 25)):
+    _sync(fn(x))
+    rows = []
+    for c in counts:
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(c):
+            y = fn(y) if chained else fn(x)
+        _sync(y)
+        rows.append((c, (time.perf_counter() - t0) * 1e3))
+    # linear fit: t = a + b*c
+    import numpy as _np
+    cs = _np.array([r[0] for r in rows], float)
+    ts = _np.array([r[1] for r in rows], float)
+    b, a = _np.polyfit(cs, ts, 1)
+    mode = "chained" if chained else "indep"
+    print(f"{name:38s} [{mode:7s}] per-op {b:8.3f} ms  overhead {a:7.1f} ms"
+          f"   raw={[f'{c}:{t:.0f}' for c, t in rows]}", flush=True)
+
+
+def main():
+    print(f"device={jax.devices()[0]}", flush=True)
+    x = jnp.ones(1_000_000, jnp.float32)
+    ew = jax.jit(lambda v: v * 1.0000001 + 1e-9)
+    series("elementwise 1M", ew, x, True)
+    series("elementwise 1M", ew, x, False)
+    xb = jnp.ones(10_500_000, jnp.float32)
+    series("elementwise 10.5M", ew, xb, True)
+    cs = jax.jit(jnp.cumsum)
+    series("cumsum 10.5M", cs, xb, True)
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    mm = jax.jit(lambda m: (m @ m) * 1e-9)
+    series("matmul 4096^3 bf16", mm, a, True)
+
+    k = jnp.asarray(np.random.randint(0, 512, 10_500_000).astype(np.int32))
+    srt = jax.jit(lambda v: lax.sort([v, v], num_keys=1,
+                                     is_stable=True)[0])
+    series("sort 2-op 10.5M", srt, k, True)
+
+    # hist2 chained: make the output feed back via a dummy dependency
+    from lightgbm_tpu.ops.pallas_hist2 import (hist2_words,
+                                               pack_words_rowmajor)
+    rng = np.random.RandomState(0)
+    N, F = 10_500_000, 28
+    bins_np = rng.randint(0, 255, size=(N, F), dtype=np.uint8)
+    words_rm = jnp.asarray(pack_words_rowmajor(bins_np))
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+
+    def mk(B, chunk):
+        def fn(gg):
+            payT = jnp.stack([gg, gg, gg])
+            hist = hist2_words(words_rm, payT, F, B, chunk)
+            return gg + hist[0, 0, 0] * 1e-20
+        return jax.jit(fn)
+    series("hist2 B=64 chunk=1024 10.5M", mk(64, 1024), g, True,
+           counts=(1, 3, 9))
+    series("hist2 B=256 chunk=1024 10.5M", mk(256, 1024), g, True,
+           counts=(1, 3, 9))
+
+
+if __name__ == "__main__":
+    main()
