@@ -1,0 +1,440 @@
+#!/usr/bin/env python
+"""Prototype kernels for the chunk-aligned level pipeline (round 3).
+
+Record layout: [nc, W, C] i32 — chunk-blocked, transposed so ROWS sit in
+the 128-lane dimension. W=16 record lanes: packed bin words, g, h (f32
+bitcast), row id, spare. All kernels stream chunk blocks; no dynamic
+slicing is needed anywhere (Mosaic requires 128-aligned lane slices).
+
+1. slot-hist: accumulates per-leaf histograms into a data-dependent output
+   block (scalar-prefetched slot map). One pass over all rows.
+
+2. move: stable two-way partition of every block in one streaming pass.
+   Per chunk: side bits from in-record bins, ranks via a triangular-matrix
+   matmul, then ONE exact byte-plane one-hot matmul routes each row
+   directly to its position in a [W, 4C] staging (left half / right half,
+   each a 2-chunk parity ring). Full chunks are DMA'd to dynamic
+   destination chunk indices of the [nc, W, C] output. The one-hot is
+   exact: each output element is a single byte value < 256 accumulated in
+   f32.
+
+Run on the real chip: python tools/proto_aligned.py [n_rows]
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+W = 16          # record lanes (i32)
+NWORDS = 7      # packed bin words for F=28
+LG, LH = NWORDS, NWORDS + 1   # g/h record lanes
+
+
+def sync(x):
+    np.asarray(jax.device_get(jax.tree.leaves(x)[0].reshape(-1)[:1]))
+
+
+def timeit(fn, *args, reps=5, warm=2):
+    for _ in range(warm):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+# ---------------------------------------------------------------------------
+# 1) slot-mapped streaming histogram (transposed records)
+# ---------------------------------------------------------------------------
+def _slot_hist_kernel(slots_ref, zeros_ref, cnts_ref, rec_ref, out_ref, *,
+                      num_features, b_pad, group, chunk):
+    i = pl.program_id(0)
+
+    @pl.when(zeros_ref[i] != 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    rec = rec_ref[0]                              # [W, C]
+    g = lax.bitcast_convert_type(rec[LG, :], jnp.float32)
+    h = lax.bitcast_convert_type(rec[LH, :], jnp.float32)
+    pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
+    valid = pos < cnts_ref[i]
+    gm = jnp.where(valid, g, 0.0)
+    hm = jnp.where(valid, h, 0.0)
+    cnt = valid.astype(jnp.float32)
+    pay = jnp.stack([gm, hm, cnt], axis=0)        # [3, C]
+    p_hi = pay.astype(jnp.bfloat16)
+    p_lo = (pay - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    pay6 = jnp.concatenate([p_hi, p_lo], axis=0)  # [6, C]
+
+    iota_b = lax.broadcasted_iota(jnp.int32, (b_pad, chunk), 0)
+    ngroups = (num_features + group - 1) // group
+    for gi in range(ngroups):
+        ohs = []
+        for j in range(group):
+            f = min(gi * group + j, num_features - 1)
+            w = rec[f >> 2, :]
+            binv = (w >> ((f & 3) * 8)) & 255
+            ohs.append((binv[None, :] == iota_b).astype(jnp.bfloat16))
+        onehot = jnp.concatenate(ohs, axis=0)     # [group*b_pad, C]
+        contrib = lax.dot_general(pay6, onehot, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[0, gi] += contrib                 # [6, group*b_pad]
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "num_features",
+                                             "b_pad", "chunk", "group"))
+def slot_hist(records, slots, cnts, num_slots, num_features, b_pad,
+              chunk, group):
+    """zeros[i] (slot-run starts) is derived from slots: a chunk zeroes its
+    output block iff it is the first chunk of its slot's run."""
+    nc = records.shape[0]
+    zeros = jnp.concatenate([jnp.ones(1, jnp.int32),
+                             (slots[1:] != slots[:-1]).astype(jnp.int32)])
+    ngroups = (num_features + group - 1) // group
+    kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
+                               b_pad=b_pad, group=group, chunk=chunk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nc,),
+        in_specs=[pl.BlockSpec((1, W, chunk), lambda i, s, z, c: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, ngroups, 6, group * b_pad),
+                               lambda i, s, z, c: (s[i], 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slots, ngroups, 6, group * b_pad),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )(slots, zeros, cnts, records)
+    out = out.reshape(num_slots, ngroups, 6, group, b_pad)
+    out = out[:, :, :3] + out[:, :, 3:]
+    out = jnp.moveaxis(out, 2, 4)  # [slots, ngroups, group, b_pad, 3]
+    out = out.reshape(num_slots, ngroups * group, b_pad, 3)
+    return out[:, :num_features]
+
+
+def slot_hist_ref(rec, slots, cnts, num_slots, num_features, b_pad):
+    """NumPy oracle over [nc, W, C] records."""
+    out = np.zeros((num_slots, num_features, b_pad, 3), np.float64)
+    nc, _, chunk = rec.shape
+    for c in range(nc):
+        s = slots[c]
+        for r in range(cnts[c]):
+            g = np.int32(rec[c, LG, r]).view(np.float32)
+            h = np.int32(rec[c, LH, r]).view(np.float32)
+            for f in range(num_features):
+                b = (rec[c, f >> 2, r] >> ((f & 3) * 8)) & 255
+                out[s, f, b, 0] += g
+                out[s, f, b, 1] += h
+                out[s, f, b, 2] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 2) move (stable two-way partition of every block, one pass)
+# ---------------------------------------------------------------------------
+def _move_kernel(route_ref, basel_ref, baser_ref, meta_ref, rec_ref,
+                 out_ref, stag, cur_ref, sems, *, chunk):
+    """Prefetched 1-D per-chunk scalars (SMEM is 1 MB; 2-D arrays pad the
+    lane dim to 128 and blow it):
+      route: thr | shift<<8 | wsel<<16
+      basel/baser: destination chunk indices of this chunk's block
+      meta: cnt | first<<20 | last<<21
+    Staging [W, 4C]: cols [0,2C) left ring, [2C,4C) right ring.
+    cur_ref: [cur_l, cur_r, flushed_l, flushed_r]."""
+    i = pl.program_id(0)
+    C = chunk
+    route = route_ref[i]
+    wsel = (route >> 16) & 255
+    shift = (route >> 8) & 255
+    thr = route & 255
+    meta = meta_ref[i]
+    is_last = (meta >> 21) & 1
+
+    @pl.when(((meta >> 20) & 1) != 0)
+    def _():
+        cur_ref[0] = 0
+        cur_ref[1] = 0
+        cur_ref[2] = 0
+        cur_ref[3] = 0
+
+    rec = rec_ref[0]                                  # [W, C]
+    pos = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
+    valid = pos < (meta & ((1 << 20) - 1))
+    word = jnp.zeros((C,), jnp.int32)
+    for wj in range(NWORDS):
+        word = jnp.where(wsel == wj, rec[wj, :], word)
+    binv = (word >> shift) & 255
+    left = (binv <= thr) & valid
+
+    li = left.astype(jnp.bfloat16)[None, :]
+    vi = valid.astype(jnp.bfloat16)[None, :]
+    both = jnp.concatenate([li, vi], axis=0)          # [2, C]
+    iota_s = lax.broadcasted_iota(jnp.int32, (C, C), 0)   # src
+    iota_d = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    tri = (iota_s < iota_d).astype(jnp.bfloat16)      # strict: src < dst
+    ranks = lax.dot_general(both, tri, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    rank_l = ranks[0].astype(jnp.int32)               # exclusive ranks
+    rank_v = ranks[1].astype(jnp.int32)
+    k_l = jnp.sum(left.astype(jnp.int32))
+    k_v = jnp.sum(valid.astype(jnp.int32))
+    rank_r = rank_v - rank_l
+
+    cur_l = cur_ref[0]
+    cur_r = cur_ref[1]
+    dst = jnp.where(left, (cur_l + rank_l) % (2 * C),
+                    2 * C + (cur_r + rank_r) % (2 * C))
+    dst = jnp.where(valid, dst, 4 * C + 5)
+
+    # exact byte-plane one-hot route into staging positions
+    planes = jnp.concatenate(
+        [((rec >> (8 * b)) & 255).astype(jnp.bfloat16) for b in range(4)],
+        axis=0)                                       # [4W, C]
+    iota_4c = lax.broadcasted_iota(jnp.int32, (C, 4 * C), 1)
+    route = (dst[:, None] == iota_4c).astype(jnp.bfloat16)   # [src, dstcol]
+    moved = lax.dot_general(planes, route, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [4W, 4C]
+    mi = moved.astype(jnp.int32)
+    mrows = (mi[:W] | (mi[W:2 * W] << 8) | (mi[2 * W:3 * W] << 16)
+             | (mi[3 * W:] << 24))                    # [W, 4C]
+
+    pos4 = lax.broadcasted_iota(jnp.int32, (1, 4 * C), 1)[0]
+    lo_l = cur_l % (2 * C)
+    hi_l = lo_l + k_l                                 # may wrap past 2C
+    in_l = (pos4 >= lo_l) & (pos4 < hi_l)
+    in_l = in_l | ((pos4 + 2 * C >= lo_l) & (pos4 + 2 * C < hi_l))
+    in_l = in_l & (pos4 < 2 * C)
+    lo_r = cur_r % (2 * C)
+    hi_r = lo_r + k_v - k_l
+    pr = pos4 - 2 * C
+    in_r = (pr >= lo_r) & (pr < hi_r)
+    in_r = in_r | ((pr + 2 * C >= lo_r) & (pr + 2 * C < hi_r))
+    in_r = in_r & (pr >= 0)
+    mask = (in_l | in_r)[None, :]
+    stag[...] = jnp.where(mask, mrows, stag[...])
+
+    new_l = cur_l + k_l
+    new_r = cur_r + k_v - k_l
+    cur_ref[0] = jnp.where(is_last != 0, 0, new_l)
+    cur_ref[1] = jnp.where(is_last != 0, 0, new_r)
+
+    def flush(side, fl_slot, cur_val):
+        base = jnp.where(side == 0, basel_ref[i], baser_ref[i])
+        for _ in range(2):         # at most 2 flushes per side per step
+            fl = cur_ref[fl_slot]
+            par = fl % 2
+            full = cur_val - fl * C >= C
+            fin = (is_last != 0) & (cur_val - fl * C > 0) & ~full
+
+            @pl.when(full | fin)
+            def _():
+                for p in range(2):
+                    @pl.when(par == p)
+                    def _():
+                        dma = pltpu.make_async_copy(
+                            stag.at[:, pl.ds(2 * C * side + p * C, C)],
+                            out_ref.at[base + fl],
+                            sems.at[side])
+                        dma.start()
+                        dma.wait()
+                cur_ref[fl_slot] = fl + 1
+
+    flush(0, 2, new_l)
+    flush(1, 3, new_r)
+
+    @pl.when(is_last != 0)
+    def _():
+        cur_ref[2] = 0
+        cur_ref[3] = 0
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "nc_out"))
+def move(records, params, chunk, nc_out=None):
+    nc = records.shape[0]
+    if nc_out is None:
+        nc_out = nc
+    route = (params[:, 2] | (params[:, 1] << 8) | (params[:, 0] << 16))
+    basel = params[:, 3]
+    baser = params[:, 4]
+    meta = (params[:, 7] | (params[:, 5] << 20) | (params[:, 6] << 21))
+    kernel = functools.partial(_move_kernel, chunk=chunk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nc,),
+        in_specs=[pl.BlockSpec((1, W, chunk),
+                               lambda i, r, bl, br, m: (i, 0, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        scratch_shapes=[
+            pltpu.VMEM((W, 4 * chunk), jnp.int32),
+            pltpu.SMEM((8,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nc_out, W, chunk), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 << 20, has_side_effects=True),
+    )(route, basel, baser, meta, records)
+
+
+def move_ref(rec, params, chunk, nc_out=None):
+    """NumPy oracle: stable partition per block, aligned destinations."""
+    nc = rec.shape[0]
+    out = np.zeros((nc_out or nc, rec.shape[1], chunk), rec.dtype)
+    lefts, rights = [], []
+    for i in range(nc):
+        wsel, shift, thr, baseL, baseR, first, last, cnt = params[i]
+        if first:
+            lefts, rights = [], []
+        rows = rec[i, :, :cnt]                       # [W, cnt]
+        binv = (rows[wsel] >> shift) & 255
+        m = binv <= thr
+        lefts.append(rows[:, m])
+        rights.append(rows[:, ~m])
+        if last:
+            for base, rs in ((baseL, lefts), (baseR, rights)):
+                allr = np.concatenate(rs, axis=1)
+                for j in range(allr.shape[1]):
+                    out[base + j // chunk, :, j % chunk] = allr[:, j]
+    return out
+
+
+# ---------------------------------------------------------------------------
+def check_correctness():
+    rng = np.random.default_rng(1)
+    chunk = 256
+    nc = 12
+    rec = rng.integers(0, 2**31 - 1, size=(nc, W, chunk), dtype=np.int32)
+    gv = rng.standard_normal((nc, chunk)).astype(np.float32)
+    hv = np.abs(rng.standard_normal((nc, chunk))).astype(np.float32)
+    rec[:, LG, :] = gv.view(np.int32)
+    rec[:, LH, :] = hv.view(np.int32)
+
+    # --- slot hist ---
+    S = 4
+    slots = np.repeat(np.arange(S, dtype=np.int32), nc // S)
+    cnts = rng.integers(chunk // 2, chunk + 1, nc).astype(np.int32)
+    try:
+        got = np.asarray(slot_hist(jnp.asarray(rec), jnp.asarray(slots),
+                                   jnp.asarray(cnts),
+                                   S, 28, 256, chunk, 4))
+        want = slot_hist_ref(rec, slots, cnts, S, 28, 256)
+        cnt_exact = np.array_equal(got[..., 2], want[..., 2])
+        scale = np.maximum(np.abs(want[..., :2]).max(), 1.0)
+        err = np.max(np.abs(got[..., :2] - want[..., :2])) / scale
+        print(f"slot-hist: counts {'EXACT' if cnt_exact else 'FAIL'}, "
+              f"g/h rel err {err:.2e} {'OK' if err < 1e-5 else 'FAIL'}",
+              flush=True)
+    except Exception as e:
+        print(f"slot-hist correctness FAILED: {type(e).__name__}: "
+              f"{str(e)[:300]}", flush=True)
+
+    # --- move: two blocks of 6 chunks each, exact dest layout ---
+    params = np.zeros((nc, 8), np.int32)
+    half = nc // 2
+    dest = 0
+    blocks = []
+    for blk, (c0, c1) in enumerate(((0, half), (half, nc))):
+        rows = np.concatenate([rec[i, :, :cnts[i]] for i in range(c0, c1)],
+                              axis=1)
+        binv = (rows[blk + 1] >> 8) & 255
+        n_l = int((binv <= 120).sum())
+        n_r = rows.shape[1] - n_l
+        baseL = dest
+        baseR = dest + (n_l + chunk - 1) // chunk
+        dest = baseR + (n_r + chunk - 1) // chunk
+        blocks.append((c0, c1, baseL, baseR, n_l, n_r))
+        params[c0:c1, 0] = blk + 1
+        params[c0:c1, 1] = 8
+        params[c0:c1, 2] = 120
+        params[c0:c1, 3] = baseL
+        params[c0:c1, 4] = baseR
+        params[c0, 5] = 1
+        params[c1 - 1, 6] = 1
+    params[:, 7] = cnts
+    nc_out = dest + 1
+    try:
+        got = np.asarray(move(jnp.asarray(rec), jnp.asarray(params), chunk,
+                              nc_out))
+    except Exception as e:
+        print(f"move correctness FAILED: {type(e).__name__}: {str(e)[:300]}",
+              flush=True)
+        return
+    want = move_ref(rec, params, chunk, nc_out)
+    ok = True
+    for (c0, c1, bL, bR, n_l, n_r) in blocks:
+        for base, cnt in ((bL, n_l), (bR, n_r)):
+            g = np.concatenate([got[base + k].T for k in
+                                range((cnt + chunk - 1) // chunk)])[:cnt]
+            w = np.concatenate([want[base + k].T for k in
+                                range((cnt + chunk - 1) // chunk)])[:cnt]
+            if not np.array_equal(g, w):
+                ok = False
+    print(f"move correctness: {'OK' if ok else 'FAIL'}", flush=True)
+
+
+def main():
+    check_correctness()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_485_760
+    rng = np.random.default_rng(0)
+    for chunk in (256, 512):
+        nc = n // chunk
+        rec = rng.integers(0, 2**31 - 1, size=(nc, W, chunk),
+                           dtype=np.int32)
+        rec_dev = jnp.asarray(rec)
+
+        for b_pad, group in ((256, 4), (64, 4), (64, 14), (16, 14)):
+            S = 384
+            per = max(nc // S, 1)
+            slots = np.repeat(np.arange(S, dtype=np.int32), per)[:nc]
+            slots = np.pad(slots, (0, nc - slots.size),
+                           constant_values=S - 1)
+            slots_dev = jnp.asarray(slots)
+            cnts_dev = jnp.asarray(np.full(nc, chunk, np.int32))
+            try:
+                t = timeit(lambda b=b_pad, g=group:
+                           slot_hist(rec_dev, slots_dev, cnts_dev,
+                                     S, 28, b, chunk, g))
+                print(f"slot-hist C={chunk} B={b_pad} group={group}: "
+                      f"{t*1e3:8.2f} ms ({t/n*1e9:5.2f} ns/row)", flush=True)
+            except Exception as e:
+                print(f"slot-hist C={chunk} B={b_pad} g={group} FAILED: "
+                      f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+        params = np.zeros((nc, 8), np.int32)
+        n_l = int((((rec[:, 1, :] >> 8) & 255) <= 127).sum())
+        baseR = (n_l + chunk - 1) // chunk
+        nc_out = baseR + (n - n_l + chunk - 1) // chunk + 1
+        params[:, 0] = 1
+        params[:, 1] = 8
+        params[:, 2] = 127
+        params[:, 3] = 0
+        params[:, 4] = baseR
+        params[0, 5] = 1
+        params[-1, 6] = 1
+        params[:, 7] = chunk
+        params_dev = jnp.asarray(params)
+        try:
+            t = timeit(lambda: move(rec_dev, params_dev, chunk, nc_out))
+            print(f"move C={chunk}: {t*1e3:8.2f} ms ({t/n*1e9:5.2f} ns/row)",
+                  flush=True)
+        except Exception as e:
+            print(f"move C={chunk} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
