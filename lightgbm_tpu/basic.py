@@ -387,6 +387,11 @@ class Booster:
         """One boosting iteration (reference basic.py:1846). Returns True if
         training finished (cannot split any more)."""
         if fobj is not None:
+            # custom gradients bypass the aligned engine's score lane:
+            # sync the lazily-stale train scores and leave aligned mode
+            # (the engine could not follow the external tree)
+            if hasattr(self._gbdt, "_drop_aligned"):
+                self._gbdt._drop_aligned()
             scores = self._gbdt.train_score.numpy()
             k = self.num_tree_per_iteration
             if k == 1:

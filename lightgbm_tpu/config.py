@@ -362,12 +362,22 @@ class Config:
     # via host replay with automatic fallback — kept opt-in: on v5e its
     # per-round full-array passes (fills + record-carrying sort) measure
     # on par with the leaf-wise program, not faster
-    tpu_grow_mode: str = "leafwise"
+    # "aligned"/"auto": the chunk-aligned record pipeline
+    # (models/aligned_builder.py + ops/aligned.py Pallas kernels) — exact
+    # leaf-wise via host replay; measured ~4x faster per round than the
+    # sort-based level builder on v5e. Auto picks aligned when its
+    # restrictions hold (numerical features, pointwise single-class
+    # objective, no bagging) and a TPU is attached, else leafwise.
+    tpu_grow_mode: str = "auto"
     # speculation slots as a multiple of num_leaves for the level builder;
     # larger values make the exact leaf-wise replay succeed on more skewed
     # trees at the cost of extra speculative histogram work
     tpu_level_spec: float = 3.0
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
+    tpu_chunk: int = 512                 # aligned-pipeline rows per chunk
+    # run the aligned pipeline's Pallas kernels in interpret mode (CPU
+    # testing only — orders of magnitude slower than the TPU kernels)
+    tpu_aligned_interpret: bool = False
     tpu_mesh_axis: str = "data"          # mesh axis name for row sharding
 
     # internal (set by trainer, reference config.h:832-833)

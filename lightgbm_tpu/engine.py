@@ -179,6 +179,8 @@ def _run_feval(feval, booster: Booster, train_name: str,
     out = []
     gbdt = booster._gbdt
     if include_train:
+        if hasattr(gbdt, "_sync_train_score"):
+            gbdt._sync_train_score()
         preds = gbdt.train_score.numpy()
         res = feval(preds[0] if preds.shape[0] == 1 else preds.T,
                     booster._train_set)

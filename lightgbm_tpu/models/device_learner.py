@@ -268,8 +268,10 @@ class DeviceTreeLearner:
         """True when the level-batched builder (`level_builder.py`) can grow
         trees for this learner: uint8 bins, serial/data parallelism, and the
         grow mode allows it. Bagged iterations always use the leaf-wise
-        path (the level records assume a full fresh root)."""
-        return (self.cfg.tpu_grow_mode in ("auto", "level")
+        path (the level records assume a full fresh root). "auto" now
+        selects the aligned pipeline or leafwise — the sort-based level
+        builder stays opt-in (measured on par with leafwise on v5e)."""
+        return (self.cfg.tpu_grow_mode == "level"
                 and self.parallel_mode in ("serial", "data")
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8
@@ -754,6 +756,43 @@ class DeviceTreeLearner:
         if root_contiguous:
             return jax.jit(fn)
         return jax.jit(fn, donate_argnums=(2,))
+
+    # ------------------------------------------------------------------
+    def aligned_mode_ok(self, objective) -> bool:
+        """True when the chunk-aligned pipeline (`aligned_builder.py`) can
+        run: TPU pallas (or interpret mode for tests), numerical features,
+        a pointwise single-class objective, serial/data parallelism."""
+        mode = self.cfg.tpu_grow_mode
+        if mode not in ("auto", "aligned"):
+            return False
+        from ..ops.aligned import aligned_available
+        if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
+            return False
+        return (self.parallel_mode == "serial"
+                and self.ds.bins is not None
+                and self.ds.bins.dtype == np.uint8
+                and self.num_features > 0
+                and self.cfg.num_leaves >= 2
+                and self.max_bin_global <= 256
+                and not bool(np.any(self.meta["bin_type"] != 0))
+                and objective is not None
+                and objective.num_model_per_iteration == 1
+                and objective.point_grad_fn() is not None)
+
+    def aligned_engine(self, objective, init_row_scores=None):
+        """The persistent AlignedEngine for (this learner, objective)."""
+        eng = getattr(self, "_aligned_eng", None)
+        if eng is None or eng.objective is not objective:
+            from .aligned_builder import AlignedEngine
+            eng = AlignedEngine(
+                self, objective,
+                interpret=bool(self.cfg.tpu_aligned_interpret),
+                init_row_scores=init_row_scores)
+            self._aligned_eng = eng
+        return eng
+
+    def drop_aligned_engine(self):
+        self._aligned_eng = None
 
     # ------------------------------------------------------------------
     def init_root_partition(self, bag_indices, bag_cnt: int):

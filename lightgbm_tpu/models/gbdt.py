@@ -57,6 +57,22 @@ class LazyTree:
         return tree
 
 
+class LazyAlignedTree(LazyTree):
+    """A tree still living as a device AlignedSpec; the host leaf-wise
+    replay runs at materialization (deterministically identical to the
+    on-device replay that committed the tree)."""
+
+    def materialize(self, rec_host=None) -> Tree:
+        from .aligned_builder import replay_spec
+        spec = rec_host if rec_host is not None else jax.device_get(
+            self.record)
+        record, _ = replay_spec(spec, self.learner.cfg.num_leaves)
+        tree = self.learner.record_to_tree(record, self.shrinkage)
+        if abs(self.bias) > K_EPSILON:
+            tree.add_bias(self.bias)
+        return tree
+
+
 class _ScoreUpdater:
     """Per-dataset cached raw scores (reference ScoreUpdater,
     score_updater.hpp:27-85)."""
@@ -248,6 +264,7 @@ class GBDT:
 
     def get_training_score(self) -> jax.Array:
         """Hook: DART drops trees from the returned score (dart.hpp:77-86)."""
+        self._sync_train_score()
         return self.train_score.score
 
     def _post_bagging_gradients(self, gdev, hdev):
@@ -274,6 +291,8 @@ class GBDT:
         if grad is None or hess is None:
             for k in range(self.num_tree_per_iteration):
                 init_scores[k] = self.boost_from_average(k)
+            if self._aligned_eligible():
+                return self._train_one_iter_aligned(init_scores)
             if self._mega_fused_eligible():
                 return self._train_one_iter_mega(init_scores)
             gdev, hdev = self._gradients()
@@ -342,6 +361,123 @@ class GBDT:
         return t
 
     # ------------------------------------------------------------------
+    def _apply_record_to_valid_scores(self, rec, trav=None,
+                                      class_id: int = 0):
+        """Add one tree record's predictions to every valid-set score
+        (shared by the fused/mega/aligned iteration paths)."""
+        cfg = self.cfg
+        for i, su in enumerate(self.valid_scores):
+            if trav is None:
+                trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
+            vb = self._valid_bins_dev[i]
+            su.score = su.score.at[class_id].set(
+                add_record_score(su.score[class_id], vb, trav,
+                                 self._trav_nb, self._trav_db,
+                                 self._trav_mt,
+                                 jnp.float32(self.shrinkage_rate)))
+        return trav
+
+    def _aligned_eligible(self) -> bool:
+        """Chunk-aligned pipeline (models/aligned_builder.py): the fastest
+        path — persistent permuted records, Pallas partition + histogram
+        kernels, gradients evaluated in permuted order. Restrictions
+        mirror _mega_fused_eligible plus the learner's aligned_mode_ok
+        (numerical features, pointwise single-class objective)."""
+        return (self.use_fused
+                and type(self.learner) is DeviceTreeLearner
+                and not getattr(self, "_aligned_disabled", False)
+                and self.num_tree_per_iteration == 1
+                and self._class_need_train[0]
+                and self.train_data.num_features > 0
+                and not self._will_bag()
+                and self.objective is not None
+                and not getattr(self.objective, "is_renew_tree_output",
+                                False)
+                and self.learner.aligned_mode_ok(self.objective)
+                ) and (
+                type(self).get_training_score is GBDT.get_training_score
+                ) and (
+                type(self)._post_bagging_gradients
+                is GBDT._post_bagging_gradients)
+
+    def _train_one_iter_aligned(self, init_scores) -> bool:
+        """One boosting iteration on the aligned engine. The engine owns
+        the training scores (a record lane, permuted); train_score is
+        synced lazily via _sync_train_score()."""
+        cfg = self.cfg
+        eng = getattr(self, "_aligned_eng_ref", None)
+        if eng is None:
+            eng = self.learner.aligned_engine(
+                self.objective,
+                init_row_scores=np.asarray(self.train_score.score[0]))
+            self._aligned_eng_ref = eng
+        fmask = self.learner.feature_mask()
+        out, exact = eng.train_iter(self.shrinkage_rate, fmask)
+        if not exact:
+            # speculation too shallow for an exact leaf-wise replay:
+            # grow this tree with the sequential leaf-wise builder and
+            # push the row scores back into the engine (rare with the
+            # need-driven speculation policy)
+            return self._aligned_fallback_iter(init_scores, eng, fmask)
+        spec, ncommit_dev = out
+        self._train_score_stale = True
+        lazy = LazyAlignedTree(spec, self.shrinkage_rate, init_scores[0],
+                               self.learner, max(cfg.num_leaves - 1, 1))
+        self.models.append(lazy)
+        if self.valid_scores:
+            # valid-set scores need the committed tree NOW (sync pull +
+            # host replay); the no-valid-set path stays fully async
+            from .aligned_builder import replay_spec
+            rec = replay_spec(jax.device_get(spec), cfg.num_leaves)[0]
+            self._apply_record_to_valid_scores(rec)
+        self._pending_numsplits.append(ncommit_dev)
+        self.iter += 1
+        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
+            return self._trim_trailing_empty()
+        return False
+
+    def _aligned_fallback_iter(self, init_scores, eng, fmask) -> bool:
+        """Exact leaf-wise tree for an iteration whose speculative build
+        could not be replayed exactly (the aligned analogue of the level
+        builder's fallback)."""
+        cfg = self.cfg
+        self._sync_train_score()
+        gdev, hdev = self._gradients()
+        idxs, rec = self.learner.train_fresh(gdev[0], hdev[0], fmask)
+        lazy = LazyTree(rec, self.shrinkage_rate, init_scores[0],
+                        self.learner, max(cfg.num_leaves - 1, 1))
+        self.models.append(lazy)
+        self.train_score.score = self.learner.add_score_from_partition(
+            self.train_score.score, 0, rec, idxs, self.shrinkage_rate)
+        self._apply_record_to_valid_scores(rec)
+        eng.set_row_scores(self.train_score.score[0])
+        self._train_score_stale = False
+        self._pending_numsplits.append(rec.num_splits)
+        self.iter += 1
+        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
+            return self._trim_trailing_empty()
+        return False
+
+    def _sync_train_score(self) -> None:
+        """Materialize row-order training scores from the aligned engine
+        (lazy: only metrics / renewal / rollback need them)."""
+        if getattr(self, "_train_score_stale", False):
+            eng = getattr(self, "_aligned_eng_ref", None)
+            if eng is not None:
+                self.train_score.score = jnp.asarray(
+                    eng.row_scores())[None, :]
+            self._train_score_stale = False
+
+    def _drop_aligned(self) -> None:
+        """Leave aligned mode permanently (rollback and other mutations
+        the permuted engine state cannot follow)."""
+        self._sync_train_score()
+        self._aligned_disabled = True
+        self._aligned_eng_ref = None
+        if hasattr(self.learner, "drop_aligned_engine"):
+            self.learner.drop_aligned_engine()
+
+    # ------------------------------------------------------------------
     def _mega_fused_eligible(self) -> bool:
         """Whole-iteration single-program path: gradients + tree build +
         score update traced together (per-program launches cost ~100-200ms
@@ -382,15 +518,7 @@ class GBDT:
         lazy = LazyTree(rec, self.shrinkage_rate, init_scores[0],
                         self.learner, max(cfg.num_leaves - 1, 1))
         self.models.append(lazy)
-        trav = None
-        for i, su in enumerate(self.valid_scores):
-            if trav is None:
-                trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
-            vb = self._valid_bins_dev[i]
-            su.score = su.score.at[0].set(
-                add_record_score(su.score[0], vb, trav, self._trav_nb,
-                                 self._trav_db, self._trav_mt,
-                                 jnp.float32(self.shrinkage_rate)))
+        self._apply_record_to_valid_scores(rec)
         self._pending_numsplits.append(rec.num_splits)
         self.iter += 1
         if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
@@ -460,14 +588,7 @@ class GBDT:
                 self.train_score.score = self.train_score.score.at[k].set(
                     self.learner.add_score(self.train_score.score[k], trav,
                                            self.shrinkage_rate))
-            for i, su in enumerate(self.valid_scores):
-                if trav is None:
-                    trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
-                vb = self._valid_bins_dev[i]
-                su.score = su.score.at[k].set(
-                    add_record_score(su.score[k], vb, trav, self._trav_nb,
-                                     self._trav_db, self._trav_mt,
-                                     jnp.float32(self.shrinkage_rate)))
+            self._apply_record_to_valid_scores(rec, trav=trav, class_id=k)
             self._pending_numsplits.append(rec.num_splits)
         if not any_trained:
             # nothing trainable this iteration: mirror the non-fused
@@ -515,6 +636,8 @@ class GBDT:
         """reference GBDT::RollbackOneIter (gbdt.cpp:450-466)."""
         if self.iter <= 0:
             return
+        if getattr(self, "_aligned_eng_ref", None) is not None:
+            self._drop_aligned()
         # drop the rolled-back iteration's deferred empty-tree records so the
         # batched trim stays aligned with self.models
         if self._pending_numsplits:
@@ -538,6 +661,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        self._sync_train_score()
         return self._eval(self.train_score, self.train_metrics, "training")
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:

@@ -1,0 +1,514 @@
+"""Chunk-aligned record pipeline — the Pallas kernels behind the aligned
+tree builder (`models/aligned_builder.py`).
+
+Replaces the reference's two hot loops with streaming TPU kernels over ONE
+persistent record matrix:
+
+- `DataPartition::Split` + `DenseBin::Split` (data_partition.hpp,
+  dense_bin.hpp:195-283) -> `move_pass`: a stable two-way partition of
+  EVERY tree block in one pass over the rows.
+- `DenseBin::ConstructHistogram` / the OpenCL kernels
+  (dense_bin.hpp:71-137, ocl/histogram256.cl:350) -> `slot_hist_pass`: one
+  streaming pass accumulating per-leaf histograms into data-dependent
+  output blocks.
+
+Record layout: `[NC, W, C] int32` — chunk-blocked and TRANSPOSED so rows
+sit in the 128-lane dimension (Mosaic only allows dynamic slicing at
+128-aligned lane offsets; with rows on lanes, whole chunks move as
+`ref.at[chunk]` DMAs and in-chunk permutations become matmuls). Lanes of
+one row live at the same lane index across the W sublanes:
+
+    0..wcnt-1 : packed bin words (4 uint8 bins per word, little-endian)
+    wcnt+0    : score   (f32 bits)
+    wcnt+1    : label   (f32 bits)
+    wcnt+2    : grad    (f32 bits)
+    wcnt+3    : hess    (f32 bits)
+    wcnt+4    : row id  (int32)
+    wcnt+5    : weight  (f32 bits, 1.0 when unweighted)
+
+Tree blocks own disjoint CHUNK-ALIGNED ranges of the record matrix, so
+every chunk belongs to exactly one block and per-chunk routing parameters
+arrive as scalar-prefetched 1-D arrays (SMEM is 1 MB; 2-D prefetch arrays
+lane-pad to 128 and blow it).
+
+The in-chunk permutation is exact: the byte-plane one-hot matmul
+(bf16 0/1 one-hot x byte planes, f32 accumulate) produces outputs that are
+each a SINGLE term < 256, so record bits survive the MXU untouched.
+
+Measured v5e floors at n=10.5M, F=28 (tools/proto_aligned.py): move
+4.5 ns/row, hist 3.5 ns/row at B=64 / 6.4 at B=256 — vs 18 ns/row for the
+11-op lax.sort partition and ~19 ns/row for the einsum histogram.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+NUM_STATS = 3          # grad, hess, count
+MISSING_NONE_C, MISSING_ZERO_C, MISSING_NAN_C = 0, 1, 2
+
+# route word 1 bit layout (per chunk)
+R_THR = 0          # bits 0..7   threshold bin
+R_SHIFT = 8        # bits 8..12  shift within word (0/8/16/24)
+R_DL = 13          # bit 13      default_left
+R_MT = 14          # bits 14..15 missing type
+R_COPY = 16        # bit 16      copy-through (unsplit block)
+# route word 2: default_bin | num_bin << 16
+# meta word: cnt | first << 20 | last << 21
+
+
+def lane_layout(wcnt: int):
+    """(lane indices, padded W) for a record with `wcnt` bin words."""
+    ls = wcnt
+    w = wcnt + 6
+    w_pad = ((w + 7) // 8) * 8
+    return dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
+                rid=ls + 4, weight=ls + 5), w_pad
+
+
+def pack_records(bins: np.ndarray, label: np.ndarray,
+                 weight, chunk: int):
+    """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
+
+    Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
+    rows in chunk i (C except the last).
+    """
+    n, f = bins.shape
+    wcnt = (f + 3) // 4
+    lanes, w_pad = lane_layout(wcnt)
+    nc = (n + chunk - 1) // chunk
+    n_pad = nc * chunk
+    padded = np.zeros((n_pad, wcnt * 4), np.uint8)
+    padded[:n, :f] = bins
+    words = padded.reshape(n_pad, wcnt, 4).astype(np.uint32)
+    packed = (words[:, :, 0] | (words[:, :, 1] << 8)
+              | (words[:, :, 2] << 16) | (words[:, :, 3] << 24))
+    rec = np.zeros((n_pad, w_pad), np.int32)
+    rec[:, :wcnt] = packed.astype(np.int64).astype(np.int32)
+    rec[:n, lanes["label"]] = np.asarray(label, np.float32).view(np.int32)
+    rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
+    wv = np.ones(n, np.float32) if weight is None \
+        else np.asarray(weight, np.float32)
+    rec[:n, lanes["weight"]] = wv.view(np.int32)
+    rec3 = np.ascontiguousarray(
+        rec.reshape(nc, chunk, w_pad).transpose(0, 2, 1))
+    cnts = np.full(nc, chunk, np.int32)
+    cnts[-1] = n - (nc - 1) * chunk
+    return rec3, wcnt, w_pad, cnts
+
+
+# ---------------------------------------------------------------------------
+# move pass
+# ---------------------------------------------------------------------------
+def _goes_left(binv, r1, r2, valid):
+    """Reference DenseBin::Split routing (dense_bin.hpp:195-255):
+    numerical with missing None/Zero/NaN; copy-through routes all left.
+
+    Pure i32 arithmetic — Mosaic can't broadcast scalar bools into vector
+    selects (arith.trunci to i1 fails), so the scalar route bits enter as
+    0/1 integers and the final bool comes from one vector comparison."""
+    thr = r1 & 255
+    dl = (r1 >> R_DL) & 1                      # scalar 0/1
+    mt = (r1 >> R_MT) & 3
+    copy = (r1 >> R_COPY) & 1
+    db = r2 & 0xFFFF
+    nb = (r2 >> 16) & 0xFFFF
+    base = (binv <= thr).astype(jnp.int32)     # vector 0/1
+    mtz = jnp.int32(0) + ((mt == MISSING_ZERO_C).astype(jnp.int32))
+    mtn = (mt == MISSING_NAN_C).astype(jnp.int32)
+    is_def = (mtz * (binv == db).astype(jnp.int32)
+              + mtn * (binv == nb - 1).astype(jnp.int32))
+    left_i = is_def * dl + (1 - is_def) * base
+    vi = valid.astype(jnp.int32)
+    out = copy * vi + (1 - copy) * left_i * vi
+    return out != 0
+
+
+def _move_kernel(r1_ref, r2_ref, bl_ref, br_ref, meta_ref, wsel_ref,
+                 hslot_ref, rec_ref, out_ref, hist_ref, stag, fbuf,
+                 cur_ref, sems, *, chunk, w_pad, wcnt, num_features,
+                 b_pad, group, dummy):
+    """One grid step of the fused move+hist pass.
+
+    SPLIT chunks: partition rows into the block's left/right staging
+    rings (exact byte-plane one-hot matmul), flush full chunks to dynamic
+    destination chunks, and accumulate the smaller child's histogram from
+    each flushed chunk. COPY chunks (unsplit blocks): one buffered DMA to
+    the prefetched direct destination, no compute.
+
+    Flushes are ASYNC: each staging half is copied to one of two per-side
+    flush buffers and DMA'd without waiting; a buffer is reused only
+    after its previous DMA is waited on (pending flags in SMEM), and the
+    final grid step drains all outstanding DMAs.
+
+    cur_ref: [cur_l, cur_r, fl_l, fl_r, pend0..5, dst0..5]."""
+    i = pl.program_id(0)
+    C = chunk
+    r1 = r1_ref[i]
+    meta = meta_ref[i]
+    is_last = (meta >> 21) & 1
+
+    @pl.when(i == 0)
+    def _():
+        # SMEM scratch is NOT zero-initialized: clear the DMA pending
+        # flags (4..9) and saved destinations (10..15) before any use
+        for j in range(16):
+            cur_ref[j] = 0
+
+    @pl.when(((meta >> 20) & 1) != 0)     # first chunk of block
+    def _():
+        cur_ref[0] = 0
+        cur_ref[1] = 0
+        cur_ref[2] = 0
+        cur_ref[3] = 0
+
+    # smaller-child histogram accumulator: zero on block entry (the out
+    # block index is constant across one block's chunks)
+    @pl.when(((meta >> 20) & 1) != 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    rec = rec_ref[0]                                  # [W, C]
+    pos = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
+    cntv = meta & ((1 << 20) - 1)
+    valid = pos < cntv
+    is_copy = (r1 >> R_COPY) & 1
+    hs = hslot_ref[i]
+
+    def wait_slot(slot):
+        pltpu.make_async_copy(fbuf.at[slot],
+                              out_ref.at[cur_ref[10 + slot]],
+                              sems.at[slot]).wait()
+        cur_ref[4 + slot] = 0
+
+    def hist_flushed(rows, nvalid):
+        """Accumulate the smaller-child histogram over a flushed [W, C]
+        chunk (first nvalid rows valid) — exactly half the tree's rows
+        get histogrammed, fused into the move (no separate pass)."""
+        posh = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
+        take = posh < nvalid
+        g = lax.bitcast_convert_type(rows[wcnt + 2, :], jnp.float32)
+        h = lax.bitcast_convert_type(rows[wcnt + 3, :], jnp.float32)
+        gm = jnp.where(take, g, 0.0)
+        hm = jnp.where(take, h, 0.0)
+        cntp = take.astype(jnp.float32)
+        pay = jnp.stack([gm, hm, cntp], axis=0)
+        p_hi = pay.astype(jnp.bfloat16)
+        p_lo = (pay - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        pay6 = jnp.concatenate([p_hi, p_lo], axis=0)
+        iota_b = lax.broadcasted_iota(jnp.int32, (b_pad, C), 0)
+        ngroups = (num_features + group - 1) // group
+        for gi in range(ngroups):
+            ohs = []
+            for j in range(group):
+                f = min(gi * group + j, num_features - 1)
+                wf = rows[f >> 2, :]
+                bv = (wf >> ((f & 3) * 8)) & 255
+                ohs.append((bv[None, :] == iota_b).astype(jnp.bfloat16))
+            onehot = jnp.concatenate(ohs, axis=0)
+            contrib = lax.dot_general(pay6, onehot,
+                                      (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            hist_ref[0, gi] += contrib
+
+    # ---- copy fast-path: unsplit blocks shift as whole chunks — one
+    # buffered DMA to the prefetched direct destination (bl), no compute
+    @pl.when((is_copy != 0) & (cntv > 0))
+    def _():
+        for cp in range(2):
+            @pl.when((i % 2) == cp)
+            def _():
+                slot = 4 + cp
+
+                @pl.when(cur_ref[4 + slot] != 0)
+                def _():
+                    wait_slot(slot)
+                fbuf[slot] = rec
+                pltpu.make_async_copy(
+                    fbuf.at[slot], out_ref.at[bl_ref[i]],
+                    sems.at[slot]).start()
+                cur_ref[4 + slot] = 1
+                cur_ref[10 + slot] = bl_ref[i]
+
+    # ---- split path
+    @pl.when(is_copy == 0)
+    def _():
+        wsel = wsel_ref[i]
+        word = rec[0, :]
+        for wj in range(1, wcnt):
+            word = jnp.where(wsel == wj, rec[wj, :], word)
+        binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
+        left = _goes_left(binv, r1, r2_ref[i], valid)
+
+        li = left.astype(jnp.bfloat16)[None, :]
+        vi = valid.astype(jnp.bfloat16)[None, :]
+        both = jnp.concatenate([li, vi], axis=0)          # [2, C]
+        iota_s = lax.broadcasted_iota(jnp.int32, (C, C), 0)
+        iota_d = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+        tri = (iota_s < iota_d).astype(jnp.bfloat16)
+        ranks = lax.dot_general(both, tri, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rank_l = ranks[0].astype(jnp.int32)
+        rank_v = ranks[1].astype(jnp.int32)
+        k_l = jnp.sum(left.astype(jnp.int32))
+        k_v = jnp.sum(valid.astype(jnp.int32))
+        rank_r = rank_v - rank_l
+
+        cur_l = cur_ref[0]
+        cur_r = cur_ref[1]
+        dst = jnp.where(left, (cur_l + rank_l) % (2 * C),
+                        2 * C + (cur_r + rank_r) % (2 * C))
+        dst = jnp.where(valid, dst, 4 * C + 5)
+
+        planes = jnp.concatenate(
+            [((rec >> (8 * b)) & 255).astype(jnp.bfloat16)
+             for b in range(4)], axis=0)                  # [4W, C]
+        iota_4c = lax.broadcasted_iota(jnp.int32, (C, 4 * C), 1)
+        route = (dst[:, None] == iota_4c).astype(jnp.bfloat16)
+        moved = lax.dot_general(planes, route, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        mi = moved.astype(jnp.int32)
+        W = w_pad
+        mrows = (mi[:W] | (mi[W:2 * W] << 8) | (mi[2 * W:3 * W] << 16)
+                 | (mi[3 * W:] << 24))
+
+        pos4 = lax.broadcasted_iota(jnp.int32, (1, 4 * C), 1)[0]
+        lo_l = cur_l % (2 * C)
+        hi_l = lo_l + k_l
+        in_l = (pos4 >= lo_l) & (pos4 < hi_l)
+        in_l = in_l | ((pos4 + 2 * C >= lo_l) & (pos4 + 2 * C < hi_l))
+        in_l = in_l & (pos4 < 2 * C)
+        lo_r = cur_r % (2 * C)
+        hi_r = lo_r + k_v - k_l
+        pr = pos4 - 2 * C
+        in_r = (pr >= lo_r) & (pr < hi_r)
+        in_r = in_r | ((pr + 2 * C >= lo_r) & (pr + 2 * C < hi_r))
+        in_r = in_r & (pr >= 0)
+        mask = (in_l | in_r)[None, :]
+        stag[...] = jnp.where(mask, mrows, stag[...])
+
+        new_l = cur_l + k_l
+        new_r = cur_r + k_v - k_l
+        cur_ref[0] = jnp.where(is_last != 0, 0, new_l)
+        cur_ref[1] = jnp.where(is_last != 0, 0, new_r)
+
+        def flush_side(side, fl_slot, base, cur_val):
+            for _ in range(2):    # at most 2 flushes per side per step
+                fl = cur_ref[fl_slot]
+                full = cur_val - fl * C >= C
+                fin = (is_last != 0) & (cur_val - fl * C > 0) & ~full
+
+                @pl.when(full | fin)
+                def _():
+                    for p in range(2):
+                        @pl.when((fl % 2) == p)
+                        def _():
+                            slot = side * 2 + p
+
+                            @pl.when(cur_ref[4 + slot] != 0)
+                            def _():
+                                wait_slot(slot)
+                            fbuf[slot] = stag[:, 2 * C * side + p * C:
+                                              2 * C * side + (p + 1) * C]
+                            pltpu.make_async_copy(
+                                fbuf.at[slot], out_ref.at[base + fl],
+                                sems.at[slot]).start()
+                            cur_ref[4 + slot] = 1
+                            cur_ref[10 + slot] = base + fl
+
+                            @pl.when(((hs & 0xFFFFFF) != dummy)
+                                     & (((hs >> 24) & 1) == side))
+                            def _():
+                                hist_flushed(
+                                    fbuf[slot],
+                                    jnp.minimum(cur_val - fl * C, C))
+                    cur_ref[fl_slot] = fl + 1
+
+        flush_side(0, 2, bl_ref[i], new_l)
+        flush_side(1, 3, br_ref[i], new_r)
+
+        @pl.when(is_last != 0)
+        def _():
+            cur_ref[2] = 0
+            cur_ref[3] = 0
+
+    @pl.when(i == pl.num_programs(0) - 1)   # drain outstanding DMAs
+    def _():
+        for slot in range(6):
+            @pl.when(cur_ref[4 + slot] != 0)
+            def _():
+                wait_slot(slot)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
+    "group", "interpret"))
+def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
+              w_pad, wcnt, num_slots, num_features, b_pad, group,
+              interpret=False):
+    """Stable two-way partition of every block in one streaming pass,
+    with the smaller-child histograms FUSED into the same pass.
+
+    records: [NC, W, C] i32; r1/r2/basel/baser/meta/wsel: [NC] i32
+    per-chunk routing (see module docstring bit layouts; wsel = split
+    word lane index of the chunk's block). hslots[i] packs the smaller
+    child's accumulation slot | side << 24 (side 0 = left rows of the
+    chunk are the smaller child); slot == num_slots skips.
+
+    Returns (records_out, hist[num_slots+1, F, b_pad, 3]). Chunks not
+    covered by the new layout keep stale rows; hist slots never present
+    in hslots hold garbage — consumers mask both.
+    """
+    nc = records.shape[0]
+    dummy = num_slots
+    ngroups = (num_features + group - 1) // group
+    kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
+                               wcnt=wcnt, num_features=num_features,
+                               b_pad=b_pad, group=group, dummy=dummy)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, w_pad, chunk),
+                         lambda i, a, b, c, d, e, f, g: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec((1, ngroups, 6, group * b_pad),
+                         lambda i, a, b, c, d, e, f, g:
+                         (g[i] & 0xFFFFFF, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
+            pltpu.VMEM((6, w_pad, chunk), jnp.int32),   # flush+copy bufs
+            pltpu.SMEM((16,), jnp.int32),
+            pltpu.SemaphoreType.DMA((6,)),
+        ],
+    )
+    out, hist = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(records.shape, jnp.int32),
+            jax.ShapeDtypeStruct(
+                (num_slots + 1, ngroups, 6, group * b_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 << 20, has_side_effects=True),
+        interpret=interpret,
+    )(r1, r2, basel, baser, meta, wsel, hslots, records)
+    hist = hist.reshape(num_slots + 1, ngroups, 6, group, b_pad)
+    hist = hist[:, :, :3] + hist[:, :, 3:]
+    hist = jnp.moveaxis(hist, 2, 4)
+    hist = hist.reshape(num_slots + 1, ngroups * group, b_pad, 3)
+    return out, hist[:num_slots, :num_features]
+
+
+# ---------------------------------------------------------------------------
+# slot-mapped histogram pass
+# ---------------------------------------------------------------------------
+def _slot_hist_kernel(slots_ref, zeros_ref, meta_ref, rec_ref, out_ref, *,
+                      num_features, b_pad, group, chunk, wcnt, dummy):
+    i = pl.program_id(0)
+
+    @pl.when(zeros_ref[i] != 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(slots_ref[i] != dummy)
+    def _():
+        rec = rec_ref[0]                              # [W, C]
+        g = lax.bitcast_convert_type(rec[wcnt + 2, :], jnp.float32)
+        h = lax.bitcast_convert_type(rec[wcnt + 3, :], jnp.float32)
+        pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
+        valid = pos < (meta_ref[i] & ((1 << 20) - 1))
+        gm = jnp.where(valid, g, 0.0)
+        hm = jnp.where(valid, h, 0.0)
+        cnt = valid.astype(jnp.float32)
+        pay = jnp.stack([gm, hm, cnt], axis=0)
+        p_hi = pay.astype(jnp.bfloat16)
+        p_lo = (pay - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        pay6 = jnp.concatenate([p_hi, p_lo], axis=0)  # [6, C]
+
+        iota_b = lax.broadcasted_iota(jnp.int32, (b_pad, chunk), 0)
+        ngroups = (num_features + group - 1) // group
+        for gi in range(ngroups):
+            ohs = []
+            for j in range(group):
+                f = min(gi * group + j, num_features - 1)
+                w = rec[f >> 2, :]
+                binv = (w >> ((f & 3) * 8)) & 255
+                ohs.append((binv[None, :] == iota_b).astype(jnp.bfloat16))
+            onehot = jnp.concatenate(ohs, axis=0)     # [group*b_pad, C]
+            contrib = lax.dot_general(pay6, onehot,
+                                      (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            out_ref[0, gi] += contrib                 # [6, group*b_pad]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
+    "interpret"))
+def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
+                   chunk, group, wcnt, interpret=False):
+    """hist[num_slots+1, F, b_pad, 3] over the record matrix.
+
+    slots[i] maps chunk i to its accumulation slot; chunks mapped to the
+    DUMMY slot (== num_slots) are skipped (their block's histogram comes
+    from parent-minus-sibling subtraction). Chunks of one slot must be
+    CONSECUTIVE in the grid (blocks are chunk ranges, so they are); a
+    slot's first chunk zeroes the block. Slots never visited keep garbage —
+    callers must only read slots present in the map.
+    """
+    nc = records.shape[0]
+    dummy = num_slots
+    ngroups = (num_features + group - 1) // group
+    zeros = jnp.concatenate([jnp.ones(1, jnp.int32),
+                             (slots[1:] != slots[:-1]).astype(jnp.int32)])
+    kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
+                               b_pad=b_pad, group=group, chunk=chunk,
+                               wcnt=wcnt, dummy=dummy)
+    w_pad = records.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nc,),
+        in_specs=[pl.BlockSpec((1, w_pad, chunk),
+                               lambda i, s, z, m: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, ngroups, 6, group * b_pad),
+                               lambda i, s, z, m: (s[i], 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_slots + 1, ngroups, 6, group * b_pad), jnp.float32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        interpret=interpret,
+    )(slots, zeros, meta, records)
+    out = out.reshape(num_slots + 1, ngroups, 6, group, b_pad)
+    out = out[:, :, :3] + out[:, :, 3:]
+    out = jnp.moveaxis(out, 2, 4)
+    out = out.reshape(num_slots + 1, ngroups * group, b_pad, 3)
+    return out[:num_slots, :num_features]
+
+
+def aligned_available() -> bool:
+    """True when the aligned pipeline's kernels can run natively."""
+    if not HAS_PALLAS:
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon") or \
+            "TPU" in str(jax.devices()[0])
+    except Exception:  # pragma: no cover
+        return False

@@ -79,6 +79,23 @@ class ObjectiveFunction:
     def _point_grad(self, score, label):
         raise NotImplementedError
 
+    def point_grad_fn(self):
+        """Pure elementwise (score, label, weight|None) -> (g, h), or
+        None when gradients are not pointwise (ranking, multiclass).
+        The aligned builder (models/aligned_builder.py) evaluates
+        gradients in PERMUTED row order, so the function must depend only
+        on the per-row values, not on stored row-order arrays."""
+        if type(self)._point_grad is ObjectiveFunction._point_grad:
+            return None
+
+        def fn(score, label, weight):
+            g, h = self._point_grad(score, label)
+            if weight is not None:
+                g = g * weight
+                h = h * weight
+            return g, h
+        return fn
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -374,9 +391,27 @@ class BinaryLogloss(ObjectiveFunction):
                 w_pos = cnt_neg / cnt_pos
                 w_neg = 1.0
         w_pos *= self.cfg.scale_pos_weight
+        self._w_pos, self._w_neg = float(w_pos), float(w_neg)
         self._sign_label = jnp.where(jnp.asarray(pos), 1.0, -1.0)
         self._label_weight = jnp.where(jnp.asarray(pos), w_pos, w_neg)
         self.need_train = cnt_pos > 0 and cnt_neg > 0
+
+    def point_grad_fn(self):
+        sig = float(self.cfg.sigmoid)
+        wp, wn = self._w_pos, self._w_neg
+
+        def fn(score, label, weight):
+            sl = jnp.where(label > 0, 1.0, -1.0)
+            lw = jnp.where(label > 0, wp, wn)
+            response = -sl * sig / (1.0 + jnp.exp(sl * sig * score))
+            absr = jnp.abs(response)
+            g = response * lw
+            h = absr * (sig - absr) * lw
+            if weight is not None:
+                g = g * weight
+                h = h * weight
+            return g, h
+        return fn
 
     def gradients_impl(self, scores):
         sig = self.cfg.sigmoid

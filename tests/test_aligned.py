@@ -1,0 +1,102 @@
+"""Aligned-pipeline parity tests (CPU: Pallas interpret mode).
+
+The chunk-aligned builder must reproduce the leaf-wise reference path
+exactly (same splits, same leaf values within float noise) — the same
+contract the sort-based level builder carries (tests/test_level.py). The
+kernels themselves are oracle-checked in tools/proto_aligned.py and on
+TPU; here the full builder + GBDT integration runs in interpret mode.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make(n=3000, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]
+          + 0.3 * rng.standard_normal(n)) > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, mode, iters=4, objective="binary", extra=None):
+    params = {"objective": objective, "num_leaves": 8, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none", "tpu_grow_mode": mode,
+              "tpu_aligned_interpret": mode == "aligned",
+              "tpu_chunk": 256}
+    if extra:
+        params.update(extra)
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+def _tree_tuples(bst):
+    g = bst._gbdt
+    g.materialized_models()
+    out = []
+    for t in g.models:
+        k = t.num_leaves - 1
+        out.append((list(t.split_feature_inner[:k]),
+                    list(t.threshold_in_bin[:k])
+                    if hasattr(t, "threshold_in_bin") else None,
+                    np.asarray(t.leaf_value[:t.num_leaves])))
+    return out
+
+
+def test_aligned_matches_leafwise_binary():
+    X, y = _make()
+    a = _train(X, y, "aligned")
+    b = _train(X, y, "leafwise")
+    ta, tb = _tree_tuples(a), _tree_tuples(b)
+    assert len(ta) == len(tb)
+    for (fa, tha, va), (fb, thb, vb) in zip(ta, tb):
+        assert fa == fb
+        assert tha == thb
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5)
+
+
+def test_aligned_matches_leafwise_regression():
+    X, y = _make()
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + y
+    a = _train(X, y, "aligned", objective="regression")
+    b = _train(X, y, "leafwise", objective="regression")
+    pa = a.predict(X[:500])
+    pb = b.predict(X[:500])
+    np.testing.assert_allclose(pa, pb, rtol=1e-3, atol=1e-4)
+
+
+def test_aligned_missing_values():
+    X, y = _make()
+    X[::7, 1] = np.nan
+    X[::5, 3] = 0.0
+    a = _train(X, y, "aligned")
+    b = _train(X, y, "leafwise")
+    pa = a.predict(X[:500])
+    pb = b.predict(X[:500])
+    np.testing.assert_allclose(pa, pb, rtol=1e-3, atol=1e-4)
+
+
+def test_aligned_train_score_sync():
+    X, y = _make(n=2000)
+    a = _train(X, y, "aligned", iters=3,
+               extra={"metric": "binary_logloss"})
+    b = _train(X, y, "leafwise", iters=3,
+               extra={"metric": "binary_logloss"})
+    ra = a.eval_train()
+    rb = b.eval_train()
+    assert ra[0][1] == rb[0][1]
+    assert abs(ra[0][2] - rb[0][2]) < 1e-4
+
+
+def test_aligned_fallbacks_to_leafwise_when_ineligible():
+    X, y = _make(n=1500)
+    # bagging makes the aligned path ineligible; training must still work
+    bst = _train(X, y, "aligned", iters=3,
+                 extra={"bagging_fraction": 0.5, "bagging_freq": 1})
+    assert bst._gbdt.iter == 3
+    assert getattr(bst._gbdt, "_aligned_eng_ref", None) is None
