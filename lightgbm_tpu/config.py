@@ -369,10 +369,11 @@ class Config:
     # restrictions hold (numerical features, pointwise single-class
     # objective, no bagging) and a TPU is attached, else leafwise.
     tpu_grow_mode: str = "auto"
-    # speculation slots as a multiple of num_leaves for the level builder;
-    # larger values make the exact leaf-wise replay succeed on more skewed
-    # trees at the cost of extra speculative histogram work
-    tpu_level_spec: float = 3.0
+    # speculation slots as a multiple of num_leaves for the level/aligned
+    # builders; larger values let the exact leaf-wise replay absorb more
+    # speculation churn (boosting residuals get noisier over iterations,
+    # so the executed-split count grows) before falling back
+    tpu_level_spec: float = 6.0
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
     tpu_chunk: int = 512                 # aligned-pipeline rows per chunk
     # run the aligned pipeline's Pallas kernels in interpret mode (CPU

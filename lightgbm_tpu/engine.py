@@ -163,7 +163,13 @@ def _seed_from_model(booster: Booster, init_booster: Booster) -> None:
     if not trees:
         return
     pred = TreePredictor(trees)
-    leaves = pred.predict_binned_leaves(td.bins)
+    bundle = None
+    if getattr(td, "bundles", None) is not None:
+        import jax.numpy as _jnp
+        b = td.bundles
+        bundle = (_jnp.asarray(b.col), _jnp.asarray(b.off),
+                  _jnp.asarray(b.packed.astype(np.int32)))
+    leaves = pred.predict_binned_leaves(td.bins, bundle)
     k = gbdt.num_tree_per_iteration
     import jax.numpy as jnp
     for i, tree in enumerate(trees):

@@ -1,0 +1,191 @@
+"""Exclusive Feature Bundling — the reference's EFB
+(`src/io/dataset.cpp:68-213` FindGroups/FastFeatureBundling,
+`include/LightGBM/feature_group.h:21`) re-designed for the TPU layout.
+
+Sparse features that are almost never non-default in the same row share
+ONE uint8 storage column: feature i of a bundle owns the bundle-bin range
+[off_i, off_i + num_bin_i - 1) holding its NON-default bins packed with
+the default bin skipped; bundle bin 0 means "every member at its
+default". Bundles are capped at 256 bins (the reference's GPU constraint,
+`dataset.cpp:78,92-93` — the same cap keeps our one-hot histogram tiles
+at one uint8 lane).
+
+Unlike the reference's FeatureGroup (which owns Bin objects), the TPU
+design keeps bundling a pure STORAGE + HISTOGRAM transform: the learner
+still sees every original feature (split finding, model export and raw
+prediction are unchanged); per-feature histograms are sliced out of the
+bundle histogram on device, with the skipped default bin reconstructed
+from leaf totals (the reference's FixHistogram, `dataset.cpp:928-947`).
+
+Singleton groups keep their original column untouched (off = 0,
+packed = False) so dense datasets pay nothing.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+
+class BundleInfo(NamedTuple):
+    """Bundling tables, all indexed by USED (virtual) feature."""
+    num_groups: int
+    col: np.ndarray        # i32[F] storage column of the feature
+    off: np.ndarray        # i32[F] bundle-bin offset (0 = unpacked)
+    packed: np.ndarray     # bool[F] True when default-skip packing applies
+    group_num_bin: np.ndarray  # i32[G] total bins of each storage column
+
+
+def find_groups(nondefault_masks: List[np.ndarray], num_bins: List[int],
+                default_bins: List[int], max_error_cnt: int,
+                max_group_bins: int = 256, seed: int = 0):
+    """Greedy conflict-bounded grouping (reference `FindGroups`,
+    dataset.cpp:68-138). nondefault_masks[i] is a bool sample mask of rows
+    where feature i is non-default. Returns a list of feature-index
+    groups."""
+    order = np.argsort([-int(m.sum()) for m in nondefault_masks])
+    rng = np.random.RandomState(seed)
+
+    def run(order):
+        groups: List[List[int]] = []
+        marks: List[np.ndarray] = []
+        conflict_cnt: List[int] = []
+        group_bins: List[int] = []
+        for fi in order:
+            m = nondefault_masks[fi]
+            nb = num_bins[fi] - 1          # packed width (default skipped)
+            placed = False
+            cand = [g for g in range(len(groups))
+                    if group_bins[g] + nb <= max_group_bins]
+            if len(cand) > 100:
+                cand = list(rng.choice(cand, 100, replace=False))
+            for g in cand:
+                cnt = int((marks[g] & m).sum())
+                if conflict_cnt[g] + cnt <= max_error_cnt:
+                    groups[g].append(int(fi))
+                    marks[g] |= m
+                    conflict_cnt[g] += cnt
+                    group_bins[g] += nb
+                    placed = True
+                    break
+            if not placed:
+                groups.append([int(fi)])
+                marks.append(m.copy())
+                conflict_cnt.append(0)
+                group_bins.append(1 + nb)
+        return groups
+
+    g1 = run(order)
+    g2 = run(rng.permutation(len(nondefault_masks)))
+    return g1 if len(g1) <= len(g2) else g2
+
+
+def plan_bundles(bins: np.ndarray, num_bins: np.ndarray,
+                 default_bins: np.ndarray, max_conflict_rate: float,
+                 sample_cnt: int = 50_000,
+                 seed: int = 0) -> Optional[BundleInfo]:
+    """Decide the bundling for a binned [N, F] matrix; None when bundling
+    would not reduce the column count."""
+    n, f = bins.shape
+    if f < 3:
+        return None
+    rng = np.random.RandomState(seed)
+    rows = (np.sort(rng.choice(n, sample_cnt, replace=False))
+            if n > sample_cnt else np.arange(n))
+    sample = bins[rows]
+    masks = [sample[:, j] != default_bins[j] for j in range(f)]
+    # only bundle genuinely sparse features; dense ones stay singleton
+    # (the reference's sampled non-zero counts play the same role)
+    sparse = [j for j in range(f)
+              if masks[j].mean() < 0.5 and num_bins[j] <= 128]
+    if len(sparse) < 2:
+        return None
+    max_err = int(max_conflict_rate * len(rows))
+    groups = find_groups([masks[j] for j in sparse],
+                         [int(num_bins[j]) for j in sparse],
+                         [int(default_bins[j]) for j in sparse],
+                         max_err, seed=seed)
+    groups = [[sparse[i] for i in g] for g in groups]
+    dense = [j for j in range(f) if j not in set(sparse)]
+    all_groups = [[j] for j in dense] + groups
+    if len(all_groups) >= f:
+        return None
+    col = np.zeros(f, np.int32)
+    off = np.zeros(f, np.int32)
+    packed = np.zeros(f, bool)
+    gnb = np.zeros(len(all_groups), np.int32)
+    for g, feats in enumerate(all_groups):
+        if len(feats) == 1:
+            j = feats[0]
+            col[j] = g
+            gnb[g] = num_bins[j]
+            continue
+        cur = 1                      # bundle bin 0 = all-default
+        for j in feats:
+            col[j] = g
+            off[j] = cur
+            packed[j] = True
+            cur += int(num_bins[j]) - 1
+        gnb[g] = cur
+    return BundleInfo(num_groups=len(all_groups), col=col, off=off,
+                      packed=packed, group_num_bin=gnb)
+
+
+def apply_bundles(bins: np.ndarray, info: BundleInfo,
+                  default_bins: np.ndarray) -> np.ndarray:
+    """[N, F] -> [N, G] bundled storage. Conflicting rows (two members
+    non-default) keep the LAST member's value, mirroring the reference's
+    conflict-tolerant push (`dataset.cpp:140-213`)."""
+    n, f = bins.shape
+    out = np.zeros((n, info.num_groups), np.uint8)
+    for j in range(f):
+        g = info.col[j]
+        b = bins[:, j].astype(np.int32)
+        if not info.packed[j]:
+            out[:, g] = b.astype(np.uint8)
+            continue
+        nd = b != default_bins[j]
+        pb = info.off[j] + np.where(b > default_bins[j], b - 1, b)
+        np.copyto(out[:, g], pb.astype(np.uint8), where=nd)
+    return out
+
+
+def unbundle_bin(bundle_bin, off, packed, default_bin, num_bin):
+    """Inverse mapping for one feature: bundle-bin column value -> the
+    feature's own bin. Single source of truth lives in
+    ops/partition.bundle_unpack (the routing/traversal hot path); this
+    NumPy-friendly alias delegates to it."""
+    from ..ops.partition import bundle_unpack
+    return np.asarray(bundle_unpack(jnp_compat(bundle_bin), off, packed,
+                                    default_bin, num_bin))
+
+
+def jnp_compat(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)
+
+
+def expansion_map(info: BundleInfo, num_bins: np.ndarray,
+                  default_bins: np.ndarray, b_cap: int):
+    """(map_idx [F, b_cap] i32, default_mask [F, b_cap] bool) for the
+    device-side histogram expansion: hist_f[b] = hist_flat[map_idx] when
+    map_idx >= 0; entries with default_mask get leaf-total minus the
+    feature's other bins (FixHistogram, dataset.cpp:928-947)."""
+    f = len(info.col)
+    map_idx = np.full((f, b_cap), -1, np.int32)
+    dmask = np.zeros((f, b_cap), bool)
+    for j in range(f):
+        g = info.col[j]
+        nb = int(num_bins[j])
+        if not info.packed[j]:
+            bs = np.arange(min(nb, b_cap))
+            map_idx[j, bs] = g * b_cap + bs
+            continue
+        db = int(default_bins[j])
+        for b in range(min(nb, b_cap)):
+            if b == db:
+                dmask[j, b] = True
+            else:
+                pb = info.off[j] + (b - 1 if b > db else b)
+                map_idx[j, b] = g * b_cap + pb
+    return map_idx, dmask

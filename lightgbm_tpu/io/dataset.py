@@ -108,6 +108,7 @@ class Dataset:
     """
 
     def __init__(self) -> None:
+        self.bundles = None
         self.num_data: int = 0
         self.num_total_features: int = 0
         self.bins: Optional[np.ndarray] = None
@@ -247,6 +248,7 @@ class Dataset:
                 bins[:, col_idx] = self.mappers[j].values_to_bins(
                     np.asarray(data[:, j], dtype=np.float64)).astype(dtype)
         self.bins = bins
+        self._maybe_bundle(cfg, reference)
 
         if label is not None:
             self.metadata.set_label(label)
@@ -256,6 +258,49 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
+    def _maybe_bundle(self, cfg, reference) -> None:
+        """Exclusive Feature Bundling (reference dataset.cpp:68-213): the
+        binned matrix shrinks to one storage column per bundle; the
+        per-feature view is reconstructed on device (io/bundling.py)."""
+        from .bundling import apply_bundles, plan_bundles
+        if reference is not None:
+            # valid sets reuse the training set's bundling so binned
+            # matrices stay aligned
+            self.bundles = getattr(reference, "bundles", None)
+            if self.bundles is not None:
+                used = self.real_feature_idx
+                db = np.asarray([self.mappers[j].default_bin for j in used],
+                                np.int32)
+                self.bins = apply_bundles(self.bins, self.bundles, db)
+            return
+        self.bundles = None
+        # Supported surface (v1): fused serial device learner with
+        # pointwise non-renewal objectives — the paths whose histogram /
+        # partition / traversal kernels understand the bundled layout.
+        renew = {"regression_l1", "l1", "mae", "huber", "fair", "quantile",
+                 "mape", "poisson", "gamma", "tweedie"}
+        if (not getattr(cfg, "enable_bundle", True) or self.bins is None
+                or self.bins.dtype != np.uint8 or self.num_features < 3
+                or cfg.tree_learner != "serial"
+                or str(cfg.boosting) not in ("gbdt", "goss")
+                or str(cfg.objective) in renew):
+            return
+        used = self.real_feature_idx
+        nb = np.asarray([self.mappers[j].num_bin for j in used], np.int32)
+        db = np.asarray([self.mappers[j].default_bin for j in used],
+                        np.int32)
+        cats = any(self.mappers[j].bin_type == BIN_CATEGORICAL
+                   for j in used)
+        if cats:
+            return    # categorical routing through bundles not supported
+        info = plan_bundles(self.bins, nb, db,
+                            float(getattr(cfg, "max_conflict_rate", 0.0)),
+                            seed=cfg.data_random_seed)
+        if info is None or info.num_groups > 0.75 * self.num_features:
+            return    # not worth the indirection
+        self.bundles = info
+        self.bins = apply_bundles(self.bins, info, db)
+
     def _native_bin_matrix(self, data: np.ndarray, used: np.ndarray,
                            dtype) -> Optional[np.ndarray]:
         """Full-matrix ingest through the native OpenMP binner
@@ -305,6 +350,7 @@ class Dataset:
         out.num_data = len(idx)
         out.num_total_features = self.num_total_features
         out.bins = None if self.bins is None else self.bins[idx]
+        out.bundles = self.bundles
         out.mappers = self.mappers
         out.used_feature_map = self.used_feature_map
         out.real_feature_idx = self.real_feature_idx
@@ -382,6 +428,13 @@ class Dataset:
             "penalty": self.feature_penalty.tolist(),
             "mappers": [m.to_dict() for m in self.mappers],
             "bins_dtype": str(self.bins.dtype) if self.bins is not None else "",
+            "bundles": (None if self.bundles is None else {
+                "num_groups": int(self.bundles.num_groups),
+                "col": self.bundles.col.tolist(),
+                "off": self.bundles.off.tolist(),
+                "packed": self.bundles.packed.tolist(),
+                "group_num_bin": self.bundles.group_num_bin.tolist(),
+            }),
             "has_label": self.metadata.label is not None,
             "has_weight": self.metadata.weight is not None,
             "has_query": self.metadata.query_boundaries is not None,
@@ -424,6 +477,15 @@ class Dataset:
                                                    dtype=np.int8)
             self.feature_penalty = np.asarray(header["penalty"])
             self.mappers = [BinMapper.from_dict(d) for d in header["mappers"]]
+            bd = header.get("bundles")
+            if bd is not None:
+                from .bundling import BundleInfo
+                self.bundles = BundleInfo(
+                    num_groups=int(bd["num_groups"]),
+                    col=np.asarray(bd["col"], np.int32),
+                    off=np.asarray(bd["off"], np.int32),
+                    packed=np.asarray(bd["packed"], bool),
+                    group_num_bin=np.asarray(bd["group_num_bin"], np.int32))
             self.metadata = Metadata(self.num_data)
             if header["bins_dtype"]:
                 self.bins = np.load(fh, allow_pickle=False)

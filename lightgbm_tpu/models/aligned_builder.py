@@ -52,6 +52,7 @@ from .level_builder import (SF_GAIN, SF_IVAL, SF_LOUT, SF_ROUT, SF_W,
 
 class AlignedSpec(NamedTuple):
     """Device outputs of one aligned speculative build (small arrays)."""
+    rounds: jax.Array      # i32 scalar: while-loop rounds executed
     n_exec: jax.Array      # i32 scalar
     execF: jax.Array       # f32[Sm1, SF_W]
     execI: jax.Array       # i32[Sm1, SI_W]
@@ -343,7 +344,8 @@ class AlignedEngine:
                 bestF[0, BF_GAIN] > 0.0)
             state = (jnp.int32(0), rec, cnts_pc, leafF, leafI, bestF,
                      bestI, bestB, hist_store, execF, execI, execB,
-                     need0, jnp.zeros(Sm1 + 1, bool), jnp.int32(0))
+                     need0, jnp.zeros(Sm1 + 1, bool), jnp.int32(0),
+                     jnp.int32(0))
 
             def cond(state):
                 done, need = state[0], state[12]
@@ -352,7 +354,7 @@ class AlignedEngine:
             def body(state):
                 (done, rec, cnts_pc, leafF, leafI, bestF, bestI, bestB,
                  hist_store, execF, execI, execB, need, _commit,
-                 _ncommit) = state
+                 _ncommit, rounds) = state
                 s_ids = jnp.arange(S + 1, dtype=jnp.int32)
                 gains = bestF[:, BF_GAIN]
                 budget = Sm1 - done
@@ -545,10 +547,10 @@ class AlignedEngine:
 
                 return (done + k, rec, cnts_pc, leafF, leafI, bestF, bestI,
                         bestB, hist_store, execF, execI, execB, need2,
-                        commit, ncommit)
+                        commit, ncommit, rounds + 1)
 
             (n_exec, rec, cnts_pc, leafF, leafI, bestF, bestI, bestB,
-             _, execF, execI, execB, need_end, commit, ncommit
+             _, execF, execI, execB, need_end, commit, ncommit, rounds
              ) = lax.while_loop(cond, body, state)
             exact = ~jnp.any(need_end)
 
@@ -579,7 +581,8 @@ class AlignedEngine:
             sc = _f32(rec[:, ln["score"], :]) + valmap[:, None] * scale_in
             rec = rec.at[:, ln["score"], :].set(_i32(sc))
 
-            spec = AlignedSpec(n_exec=n_exec, execF=execF[:Sm1],
+            spec = AlignedSpec(rounds=rounds, n_exec=n_exec,
+                               execF=execF[:Sm1],
                                execI=execI[:Sm1], execB=execB[:Sm1],
                                bestF=bestF[:S], bestI=bestI[:S],
                                bestB=bestB[:S], leafF=leafF[:S],

@@ -256,6 +256,28 @@ class DeviceTreeLearner:
         self._mono_any = bool(np.any(meta["monotone"] != 0))
         self._build_cache: Dict[Tuple[int, bool], callable] = {}
         self._depth_limit = cfg.max_depth if cfg.max_depth > 0 else 1 << 30
+        # Exclusive Feature Bundling view (io/bundling.py): bins columns
+        # are bundles; per-feature histograms are sliced out on device
+        bnd = getattr(dataset, "bundles", None)
+        self.bundled = bnd is not None
+        if self.bundled:
+            from ..io.bundling import expansion_map
+            self.hist_bins = int(max(self.max_bin_global,
+                                     bnd.group_num_bin.max()))
+            m_idx, dmask = expansion_map(bnd, meta["num_bin"],
+                                         meta["default_bin"],
+                                         self.hist_bins)
+            self._emap_dev = jnp.asarray(m_idx[:, :self.max_bin_global])
+            self._edef_dev = jnp.asarray(
+                dmask[:, :self.max_bin_global].astype(np.float32))
+            self._col_dev = jnp.asarray(bnd.col, jnp.int32)
+            self._boff_dev = jnp.asarray(bnd.off, jnp.int32)
+            self._bpk_dev = jnp.asarray(bnd.packed.astype(np.int32))
+        else:
+            self.hist_bins = self.max_bin_global
+            self._col_dev = jnp.arange(self.num_features, dtype=jnp.int32)
+            self._boff_dev = jnp.zeros(self.num_features, jnp.int32)
+            self._bpk_dev = jnp.zeros(self.num_features, jnp.int32)
 
     @property
     def bins_dev(self) -> jax.Array:
@@ -272,6 +294,7 @@ class DeviceTreeLearner:
         selects the aligned pipeline or leafwise — the sort-based level
         builder stays opt-in (measured on par with leafwise on v5e)."""
         return (self.cfg.tpu_grow_mode == "level"
+                and not self.bundled
                 and self.parallel_mode in ("serial", "data")
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8
@@ -329,7 +352,10 @@ class DeviceTreeLearner:
         """score += scale * tree(x) over the training bins."""
         return add_record_score(score_row, self.bins_dev, trav, self._nb_dev,
                                 self._db_dev, self._mt_dev,
-                                jnp.float32(scale))
+                                jnp.float32(scale),
+                                self._col_dev if self.bundled else None,
+                                self._boff_dev if self.bundled else None,
+                                self._bpk_dev if self.bundled else None)
 
     def add_score_from_partition(self, score: jax.Array, class_id: int,
                                  record: "TreeRecord", indices: jax.Array,
@@ -397,6 +423,24 @@ class DeviceTreeLearner:
         Lm1 = max(L - 1, 1)
         F = self.num_features
         B = self.max_bin_global
+        BH = self.hist_bins
+        bundled = self.bundled
+        if bundled:
+            emap, edef = self._emap_dev, self._edef_dev
+
+            def expand_hist(hist_g, sg, sh, cnt):
+                """[G, BH, 3] bundle histogram -> [F, B, 3] per-feature
+                view; skipped default bins come from leaf totals
+                (FixHistogram, dataset.cpp:928-947)."""
+                flat = hist_g.reshape(-1, NUM_HIST_STATS)
+                safe = jnp.clip(emap, 0, flat.shape[0] - 1)
+                out = flat[safe] * (emap >= 0)[:, :, None]
+                totals = jnp.stack([sg, sh, cnt.astype(jnp.float32)])
+                fix = totals[None, :] - jnp.sum(out, axis=1)
+                # the count channel must stay an exact integer or the
+                # min_data_in_leaf guards flip on reconstruction noise
+                fix = fix.at[:, 2].set(jnp.round(fix[:, 2]))
+                return out + edef[:, :, None] * fix[:, None, :]
         buckets = self._buckets_for(root_padded)
         nbk = len(buckets)
         finder = self.finder
@@ -424,8 +468,8 @@ class DeviceTreeLearner:
 
         def _feature_block_hist(rows, gh, valid):
             if mode != "feature":
-                return histogram_from_gathered_gh(rows, gh, valid, B, chunk,
-                                                  precision)
+                return histogram_from_gathered_gh(rows, gh, valid, BH,
+                                                  chunk, precision)
             # feature-parallel: each shard histograms only its feature block
             # (reference feature_parallel_tree_learner.cpp:33-52 work
             # division); the psum that follows assembles the global
@@ -434,7 +478,7 @@ class DeviceTreeLearner:
             size = rows.shape[0]
             rows = lax.dynamic_slice(rows, (jnp.int32(0), start),
                                      (size, f_block))
-            hb = histogram_from_gathered_gh(rows, gh, valid, B, chunk,
+            hb = histogram_from_gathered_gh(rows, gh, valid, BH, chunk,
                                             precision)
             full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
             return lax.dynamic_update_slice(
@@ -451,14 +495,19 @@ class DeviceTreeLearner:
 
         def part_bucket(size):
             def fn(bins_col, indices, begin, count, threshold, default_left,
-                   missing_type, default_bin, num_bin, is_cat, bitset):
+                   missing_type, default_bin, num_bin, is_cat, bitset,
+                   boff, bpk):
                 return split_partition(indices, bins_col, begin, count, size,
                                        threshold, default_left, missing_type,
-                                       default_bin, num_bin, is_cat, bitset)
+                                       default_bin, num_bin, is_cat, bitset,
+                                       boff, bpk)
             return fn
 
         hist_fns = [hist_bucket(s) for s in buckets]
         part_fns = [part_bucket(s) for s in buckets]
+        col_dev = self._col_dev
+        boff_dev = self._boff_dev
+        bpk_dev = self._bpk_dev
         axis = self.axis_name
 
         # Collective placement by mode (all ride ICI as XLA all-reduces;
@@ -552,6 +601,8 @@ class DeviceTreeLearner:
                     return _payload(out, _mask_gain(gain, depth))
             else:
                 def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                    if bundled:
+                        hist = expand_hist(hist, sg, sh, cnt)
                     out = finder(hist, sg, sh, cnt, minc, maxc)
                     return _payload(out, _mask_gain(out["gain"], depth))
 
@@ -563,7 +614,7 @@ class DeviceTreeLearner:
                 rp = min(root_padded, bins.shape[0], gh.shape[0])
                 pos = jnp.arange(rp, dtype=jnp.int32)
                 valid = pos < root_count
-                rows = lax.slice(bins, (0, 0), (rp, F))
+                rows = lax.slice(bins, (0, 0), (rp, bins.shape[1]))
                 gh0 = lax.slice(gh, (0, 0), (rp, 2))
                 root_hist = _feature_block_hist(rows, gh0, valid)
                 sums = jnp.sum(jnp.where(valid[:, None], gh0, 0.0), axis=0)
@@ -582,7 +633,10 @@ class DeviceTreeLearner:
             root_count_g = _gsum_scalar(root_count)
 
             # ---------- packed state ----------
-            hist_store = jnp.zeros((L, F, B, NUM_HIST_STATS), jnp.float32)
+            ncols = F if not bundled else len(
+                np.asarray(self.ds.bundles.group_num_bin))
+            hist_store = jnp.zeros((L, ncols, BH, NUM_HIST_STATS),
+                                   jnp.float32)
             hist_store = hist_store.at[0].set(root_hist)
             leafF = jnp.zeros((L, LF_W), jnp.float32)
             leafF = leafF.at[:, LF_MINC].set(-jnp.inf)
@@ -637,13 +691,16 @@ class DeviceTreeLearner:
                 left_cnt_g = bI[BI_LC]
                 right_cnt_g = bI[BI_RC]
                 smaller_is_left = left_cnt_g <= right_cnt_g
-                # contiguous column read from the transposed bins
+                # contiguous column read from the transposed bins (the
+                # feature's STORAGE column under bundling)
                 bins_col = lax.dynamic_slice(
-                    bins_T, (f, jnp.int32(0)), (1, bins_T.shape[1]))[0]
+                    bins_T, (col_dev[f], jnp.int32(0)),
+                    (1, bins_T.shape[1]))[0]
                 bk = self._bucket_index(count, buckets)
                 new_indices, left_cnt = lax.switch(
                     bk, part_fns, bins_col, indices, begin, count, thr,
-                    dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bB)
+                    dleft, mt_dev[f], db_dev[f], nb_dev[f], iscat, bB,
+                    boff_dev[f], bpk_dev[f])
                 right_cnt = count - left_cnt
 
                 # ---- packed record row
@@ -769,6 +826,7 @@ class DeviceTreeLearner:
         if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
             return False
         return (self.parallel_mode == "serial"
+                and not self.bundled
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8
                 and self.num_features > 0
@@ -996,9 +1054,11 @@ def traversal_arrays(rec: TreeRecord, max_nodes: int):
 
 
 @jax.jit
-def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt) -> jax.Array:
+def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt,
+                    col=None, boff=None, bpk=None) -> jax.Array:
     """[N] leaf index per row for one TreeRecord's tree over binned data.
-    nb/db/mt: per-feature num_bin/default_bin/missing arrays."""
+    nb/db/mt: per-feature num_bin/default_bin/missing arrays; col/boff/bpk
+    map features to bundled storage columns (EFB, io/bundling.py)."""
     n = bins.shape[0]
 
     def cond(node):
@@ -1007,7 +1067,12 @@ def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt) -> jax.Array:
     def body(node):
         safe = jnp.maximum(node, 0)
         feat = trav["feature"][safe]
-        fval = bins[jnp.arange(n), feat].astype(jnp.int32)
+        scol = feat if col is None else col[feat]
+        fval = bins[jnp.arange(n), scol].astype(jnp.int32)
+        if boff is not None:
+            from ..ops.partition import bundle_unpack
+            fval = bundle_unpack(fval, boff[feat], bpk[feat], db[feat],
+                                 nb[feat])
         gl_num = numerical_goes_left(fval, trav["threshold_bin"][safe],
                                      trav["default_left"][safe], mt[feat],
                                      db[feat], nb[feat])
@@ -1028,7 +1093,8 @@ def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt) -> jax.Array:
 
 @jax.jit
 def add_record_score(score_row: jax.Array, bins: jax.Array, trav: Dict,
-                     nb, db, mt, scale) -> jax.Array:
+                     nb, db, mt, scale, col=None, boff=None,
+                     bpk=None) -> jax.Array:
     """score += scale * tree(x) for all rows via record traversal."""
-    leaves = traverse_record(bins, trav, nb, db, mt)
+    leaves = traverse_record(bins, trav, nb, db, mt, col, boff, bpk)
     return score_row + scale * trav["leaf_value"][leaves]

@@ -110,6 +110,14 @@ class _ScoreUpdater:
 class GBDT:
     """reference `GBDT` (gbdt.h:41+)."""
 
+    def _bundle_arrays(self):
+        """(col, boff, bpk) for binned traversal when the training bins
+        are EFB-bundled (valid sets share the training bundling)."""
+        if getattr(self.learner, "bundled", False):
+            lr = self.learner
+            return (lr._col_dev, lr._boff_dev, lr._bpk_dev)
+        return None
+
     _fused_ok = True  # DART/RF override: they reshape scores via host trees
 
     def __init__(self, cfg: Config, train_data: Dataset,
@@ -206,7 +214,7 @@ class GBDT:
         if self.models:
             models = self.materialized_models()
             pred = TreePredictor(models)
-            leaves = pred.predict_binned_leaves(ds.bins)
+            leaves = pred.predict_binned_leaves(ds.bins, self._bundle_arrays())
             for i, tree in enumerate(models):
                 su.add_tree_by_leaves(leaves[i],
                                       tree.leaf_value[:tree.num_leaves],
@@ -276,7 +284,7 @@ class GBDT:
                             class_id: int, scale: float = 1.0) -> None:
         """Add scale * tree(x) into a score updater via binned traversal."""
         pred = TreePredictor([tree])
-        leaves = pred.predict_binned_leaves(bins)[0]
+        leaves = pred.predict_binned_leaves(bins, self._bundle_arrays())[0]
         su.add_tree_by_leaves(
             leaves, tree.leaf_value[:tree.num_leaves] * scale, class_id)
 
@@ -370,11 +378,15 @@ class GBDT:
             if trav is None:
                 trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
             vb = self._valid_bins_dev[i]
+            bundled = getattr(self.learner, "bundled", False)
             su.score = su.score.at[class_id].set(
                 add_record_score(su.score[class_id], vb, trav,
                                  self._trav_nb, self._trav_db,
                                  self._trav_mt,
-                                 jnp.float32(self.shrinkage_rate)))
+                                 jnp.float32(self.shrinkage_rate),
+                                 self.learner._col_dev if bundled else None,
+                                 self.learner._boff_dev if bundled else None,
+                                 self.learner._bpk_dev if bundled else None))
         return trav
 
     def _aligned_eligible(self) -> bool:
@@ -624,11 +636,11 @@ class GBDT:
         one binned traversal (covers in-bag and out-of-bag rows alike), valid
         scores likewise."""
         pred = TreePredictor([tree])
-        leaves = pred.predict_binned_leaves(self.train_data.bins)[0]
+        leaves = pred.predict_binned_leaves(self.train_data.bins, self._bundle_arrays())[0]
         self.train_score.add_tree_by_leaves(
             leaves, tree.leaf_value[:tree.num_leaves], class_id)
         for ds, su in zip(self.valid_sets, self.valid_scores):
-            vleaves = pred.predict_binned_leaves(ds.bins)[0]
+            vleaves = pred.predict_binned_leaves(ds.bins, self._bundle_arrays())[0]
             su.add_tree_by_leaves(vleaves,
                                   tree.leaf_value[:tree.num_leaves], class_id)
 
@@ -649,11 +661,11 @@ class GBDT:
             if tree.num_leaves > 1:
                 # subtract the tree's contribution (Shrinkage(-1) + AddScore)
                 pred = TreePredictor([tree])
-                leaves = pred.predict_binned_leaves(self.train_data.bins)[0]
+                leaves = pred.predict_binned_leaves(self.train_data.bins, self._bundle_arrays())[0]
                 self.train_score.add_tree_by_leaves(
                     leaves, -tree.leaf_value[:tree.num_leaves], k)
                 for ds, su in zip(self.valid_sets, self.valid_scores):
-                    vleaves = pred.predict_binned_leaves(ds.bins)[0]
+                    vleaves = pred.predict_binned_leaves(ds.bins, self._bundle_arrays())[0]
                     su.add_tree_by_leaves(
                         vleaves, -tree.leaf_value[:tree.num_leaves], k)
         del self.models[-self.num_tree_per_iteration:]

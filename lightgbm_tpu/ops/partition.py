@@ -48,6 +48,17 @@ def categorical_goes_left(binvals: jax.Array, bitset: jax.Array) -> jax.Array:
     return hit & (word < bitset.shape[0])
 
 
+def bundle_unpack(raw, boff, bpk, default_bin, num_bin):
+    """Bundled storage column -> the feature's own bin (io/bundling.py
+    layout: the feature owns [boff, boff + num_bin - 1) with the default
+    bin skipped; anything outside its range means default)."""
+    p = raw - boff
+    in_range = (p >= 0) & (p < num_bin - 1)
+    b = jnp.where(p >= default_bin, p + 1, p)
+    unpacked = jnp.where(in_range, b, default_bin)
+    return jnp.where(bpk != 0, unpacked, raw)
+
+
 @functools.partial(jax.jit, static_argnames=("padded",))
 def split_partition(indices: jax.Array, bins_col: jax.Array,
                     begin: jax.Array,
@@ -55,7 +66,10 @@ def split_partition(indices: jax.Array, bins_col: jax.Array,
                     default_left: jax.Array, missing_type: jax.Array,
                     default_bin: jax.Array, num_bin: jax.Array,
                     is_categorical: jax.Array,
-                    cat_bitset: jax.Array) -> Tuple[jax.Array, jax.Array]:
+                    cat_bitset: jax.Array,
+                    bundle_off: jax.Array = 0,
+                    bundle_packed: jax.Array = 0
+                    ) -> Tuple[jax.Array, jax.Array]:
     """Stable-partition one leaf's slice of the global index array.
 
     indices:  int32 [N_pad] permuted row ids (leaf rows contiguous)
@@ -71,6 +85,7 @@ def split_partition(indices: jax.Array, bins_col: jax.Array,
     valid = pos < count
     safe = jnp.where(valid, idx, 0)
     b = bins_col[safe].astype(jnp.int32)
+    b = bundle_unpack(b, bundle_off, bundle_packed, default_bin, num_bin)
     gl_num = numerical_goes_left(b, threshold, default_left, missing_type,
                                  default_bin, num_bin)
     gl_cat = categorical_goes_left(b, cat_bitset)

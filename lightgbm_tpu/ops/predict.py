@@ -86,9 +86,9 @@ def stack_trees(trees: List[Tree], binned: bool) -> Dict[str, np.ndarray]:
 
 
 @jax.jit
-def _predict_binned_stacked(bins, stk):
+def _predict_binned_stacked(bins, stk, bundle=None):
     """Traverse all trees over the binned matrix; returns [T, N] leaf
-    indices."""
+    indices. `bundle` = (col, boff, bpk) per-feature arrays under EFB."""
     n = bins.shape[0]
     dt = stk["decision_type"]
     thr_bin = stk["threshold_in_bin"]
@@ -99,7 +99,7 @@ def _predict_binned_stacked(bins, stk):
     clen = stk["cat_len"]
     cwords = stk["cat_words"]
 
-    def decide(tree_idx, node, fval):
+    def decide(tree_idx, node, fval, feat):
         d = dt[tree_idx, node].astype(jnp.int32)
         is_cat = (d & 1) != 0
         default_left = (d & 2) != 0
@@ -107,6 +107,10 @@ def _predict_binned_stacked(bins, stk):
         tb = thr_bin[tree_idx, node]
         db = dbin[tree_idx, node]
         nb = nbin[tree_idx, node]
+        if bundle is not None:
+            from .partition import bundle_unpack
+            fval = bundle_unpack(fval, bundle[1][feat], bundle[2][feat],
+                                 db, nb)
         base = fval <= tb
         is_default = jnp.where(mt == MISSING_ZERO_C, fval == db,
                                jnp.where(mt == MISSING_NAN_C,
@@ -131,8 +135,9 @@ def _predict_binned_stacked(bins, stk):
         def body(node):
             safe = jnp.maximum(node, 0)
             feat = sf[tree_idx, safe]                     # [N]
-            fval = bins[jnp.arange(n), feat].astype(jnp.int32)
-            go_left = decide(tree_idx, safe, fval)
+            scol = feat if bundle is None else bundle[0][feat]
+            fval = bins[jnp.arange(n), scol].astype(jnp.int32)
+            go_left = decide(tree_idx, safe, fval, feat)
             nxt = jnp.where(go_left, lc[tree_idx, safe], rc[tree_idx, safe])
             return jnp.where(node >= 0, nxt, node)
 
@@ -157,10 +162,11 @@ class TreePredictor:
         return {k: jnp.asarray(v) for k, v in stk.items()
                 if isinstance(v, np.ndarray)}
 
-    def predict_binned_leaves(self, bins) -> jax.Array:
-        """[T, N] leaf indices over binned data."""
+    def predict_binned_leaves(self, bins, bundle=None) -> jax.Array:
+        """[T, N] leaf indices over binned data. `bundle` = (col, boff,
+        bpk) device arrays when the matrix is EFB-bundled."""
         stk = self._stacked(binned=True)
-        return _predict_binned_stacked(jnp.asarray(bins), stk)
+        return _predict_binned_stacked(jnp.asarray(bins), stk, bundle)
 
     def predict_binned_score(self, bins) -> jax.Array:
         """[T, N] -> summed leaf values [N] (f64 on host for exactness is the
