@@ -715,6 +715,12 @@ class Booster:
                     listen_time_out=120, num_machines=1) -> "Booster":
         # TPU build: collectives ride the jax.sharding mesh, not sockets
         # (reference basic.py:1737; network seam = parallel/ learners)
+        import warnings
+        warnings.warn(
+            "set_network is a no-op on the TPU build: distribution is "
+            "configured by tree_learner=data/feature/voting over the "
+            "jax.sharding mesh (machines/ports do not apply)",
+            stacklevel=2)
         return self
 
     def __copy__(self):

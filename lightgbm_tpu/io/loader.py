@@ -184,6 +184,13 @@ class DatasetLoader:
         round-robin across ranks (reference random / in-order partition,
         `dataset_loader.cpp:606-650`)."""
         cfg = self.config
+        if getattr(cfg, "two_round", False):
+            import warnings
+            warnings.warn(
+                "two_round loading is not implemented on the TPU build "
+                "(datasets are binned in one pass; use "
+                "bin_construct_sample_cnt to bound sampling memory)",
+                stacklevel=2)
         if cfg.save_binary or filename.endswith(".bin"):
             binpath = filename if filename.endswith(".bin") \
                 else filename + ".bin"

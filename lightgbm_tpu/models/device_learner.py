@@ -635,9 +635,30 @@ class DeviceTreeLearner:
             # ---------- packed state ----------
             ncols = F if not bundled else len(
                 np.asarray(self.ds.bundles.group_num_bin))
+            # histogram_pool_size (reference HistogramPool,
+            # feature_histogram.hpp:654-829): the reference bounds the
+            # per-leaf histogram cache in MB with LRU + recompute; the
+            # TPU store is one [L, F, B, 3] array, so the budget is
+            # honored by dropping the store to bf16 (half memory; the
+            # subtract trick upcasts to f32). A budget below even the
+            # bf16 store warns.
+            store_dtype = jnp.float32
+            pool_mb = float(cfg.histogram_pool_size)
+            if pool_mb > 0:
+                f32_mb = L * ncols * BH * NUM_HIST_STATS * 4 / 2**20
+                if f32_mb > pool_mb:
+                    store_dtype = jnp.bfloat16
+                    if f32_mb / 2 > pool_mb:
+                        import warnings
+                        warnings.warn(
+                            "histogram_pool_size=%.0fMB < bf16 store "
+                            "(%.0fMB); the TPU build cannot go lower "
+                            "without per-leaf recompute" %
+                            (pool_mb, f32_mb / 2))
             hist_store = jnp.zeros((L, ncols, BH, NUM_HIST_STATS),
-                                   jnp.float32)
-            hist_store = hist_store.at[0].set(root_hist)
+                                   store_dtype)
+            hist_store = hist_store.at[0].set(
+                root_hist.astype(store_dtype))
             leafF = jnp.zeros((L, LF_W), jnp.float32)
             leafF = leafF.at[:, LF_MINC].set(-jnp.inf)
             leafF = leafF.at[:, LF_MAXC].set(jnp.inf)
@@ -766,11 +787,13 @@ class DeviceTreeLearner:
                 sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
                                      gh, sm_begin, sm_count)
                 sm_hist = _gsum_hist(sm_hist)
-                lg_hist = hist_store[bl] - sm_hist
+                lg_hist = hist_store[bl].astype(jnp.float32) - sm_hist
                 left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
                 right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
-                hist_store = hist_store.at[bl].set(left_hist)
-                hist_store = hist_store.at[new_leaf].set(right_hist)
+                hist_store = hist_store.at[bl].set(
+                    left_hist.astype(hist_store.dtype))
+                hist_store = hist_store.at[new_leaf].set(
+                    right_hist.astype(hist_store.dtype))
 
                 # evaluate both children (global counts)
                 lF, lI, lB = eval_leaf(left_hist, bF[BF_LG], bF[BF_LH],

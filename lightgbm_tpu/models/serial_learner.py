@@ -81,6 +81,19 @@ class SerialTreeLearner:
         self.hist_precision = ("f32" if cfg.gpu_use_dp or cfg.tpu_use_f64_hist
                                else "bf16x2")
         self._monotone_any = bool(np.any(meta["monotone"] != 0))
+        # CEGB state (serial_tree_learner.cpp:110-115,537-568): coupled
+        # penalties charge a feature's cost once per MODEL, lazy penalties
+        # once per (feature, row)
+        self._cegb_on = (cfg.cegb_penalty_split > 0
+                         or len(cfg.cegb_penalty_feature_coupled) > 0
+                         or len(cfg.cegb_penalty_feature_lazy) > 0)
+        self._cegb_feature_used = np.zeros(dataset.num_total_features, bool)
+        self._cegb_lazy_marked: Dict[int, np.ndarray] = {}
+        self._forced = None
+        if cfg.forcedsplits_filename:
+            import json as _json
+            with open(cfg.forcedsplits_filename) as fh:
+                self._forced = _json.load(fh)
 
     # ------------------------------------------------------------------
     def _feature_mask(self) -> Optional[np.ndarray]:
@@ -115,6 +128,8 @@ class SerialTreeLearner:
         # depth limit (BeforeFindBestSplit, serial_tree_learner.cpp:364-377)
         if 0 < self.cfg.max_depth <= leaf.depth:
             gain = np.full_like(gain, -np.inf)
+        if self._cegb_on:
+            gain = gain - self._cegb_penalties(leaf)
         best_f = int(np.argmax(gain))
         res = {
             "feature": best_f,
@@ -150,6 +165,194 @@ class SerialTreeLearner:
         return res
 
     # ------------------------------------------------------------------
+    def _cegb_penalties(self, leaf: "_LeafInfo") -> np.ndarray:
+        """Per-feature CEGB gain penalties for one leaf (reference
+        serial_tree_learner.cpp:537-568 + CalculateOndemandCosts :488):
+        split penalty scales with leaf rows; coupled penalties charge
+        unused features once per model; lazy penalties charge the leaf
+        rows that never passed a split on the feature before."""
+        cfg = self.cfg
+        F = self.num_features
+        pen = np.full(F, cfg.cegb_tradeoff * cfg.cegb_penalty_split
+                      * leaf.count, np.float64)
+        real = self.ds.real_feature_idx
+        coupled = cfg.cegb_penalty_feature_coupled
+        if len(coupled):
+            c = np.asarray(coupled, np.float64)[real]
+            pen += cfg.cegb_tradeoff * np.where(
+                self._cegb_feature_used[real], 0.0, c)
+        lazy = cfg.cegb_penalty_feature_lazy
+        if len(lazy):
+            lz = np.asarray(lazy, np.float64)[real]
+            rows = np.asarray(self.indices[leaf.begin:
+                                           leaf.begin + leaf.count])
+            for f in range(F):
+                if lz[f] == 0.0:
+                    continue
+                marked = self._cegb_lazy_marked.get(f)
+                fresh = leaf.count if marked is None else int(
+                    (~marked[rows]).sum())
+                pen[f] += cfg.cegb_tradeoff * lz[f] * fresh
+        return pen
+
+    def _cegb_commit(self, f: int, begin: int, count: int) -> None:
+        if not self._cegb_on:
+            return
+        self._cegb_feature_used[int(self.ds.real_feature_idx[f])] = True
+        if len(self.cfg.cegb_penalty_feature_lazy):
+            marked = self._cegb_lazy_marked.get(f)
+            if marked is None:
+                marked = np.zeros(self.n, bool)
+                self._cegb_lazy_marked[f] = marked
+            rows = np.asarray(self.indices[begin:begin + count])
+            marked[rows] = True
+
+    # ------------------------------------------------------------------
+    def _forced_split_info(self, leaf: "_LeafInfo", f: int,
+                           thr_bin: int) -> dict:
+        """Split info AT a forced threshold from the leaf histogram
+        (reference GatherInfoForThreshold, feature_histogram.hpp:290+)."""
+        from ..ops.split import threshold_l1_host
+        cfg = self.cfg
+        hist = np.asarray(leaf.hist[f], np.float64)        # [B, 3]
+        mapper = self.mappers[f]
+        nb = mapper.num_bin
+        mt = mapper.missing_type
+        hi = min(thr_bin + 1, nb)
+        lg = hist[:hi, 0].sum()
+        lh = hist[:hi, 1].sum()
+        lc = int(round(hist[:hi, 2].sum()))
+        if mt == "nan" and hi > nb - 1:
+            # NaN bin routes right under default_left=False
+            lg -= hist[nb - 1, 0]
+            lh -= hist[nb - 1, 1]
+            lc -= int(round(hist[nb - 1, 2]))
+        rg, rh = leaf.sum_g - lg, leaf.sum_h - lh
+        rc = leaf.count - lc
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+
+        def out(sg, sh):
+            return float(-threshold_l1_host(np.float64(sg), l1)
+                         / (sh + l2)) if sh + l2 > 0 else 0.0
+
+        def part_gain(sg, sh):
+            t = threshold_l1_host(np.float64(sg), l1)
+            return float(t * t / (sh + l2)) if sh + l2 > 0 else 0.0
+
+        gain = part_gain(lg, lh) + part_gain(rg, rh) \
+            - part_gain(leaf.sum_g, leaf.sum_h)
+        return {"feature": f, "gain": gain, "threshold": int(thr_bin),
+                "default_left": False, "left_g": lg, "left_h": lh,
+                "left_c": lc, "right_g": rg, "right_h": rh, "right_c": rc,
+                "left_output": out(lg, lh), "right_output": out(rg, rh),
+                "is_cat": False}
+
+    def _apply_forced_splits(self, tree: Tree, leaves: Dict, grad, hess,
+                             feature_mask) -> None:
+        """BFS the forced-splits JSON before gain-driven growth
+        (reference ForceSplits, serial_tree_learner.cpp:597-755)."""
+        if self._forced is None:
+            return
+        from collections import deque
+        cfg = self.cfg
+        q = deque([(0, self._forced)])
+        while q and tree.num_leaves < cfg.num_leaves:
+            lid, node = q.popleft()
+            if not isinstance(node, dict) or "feature" not in node:
+                continue
+            real_f = int(node["feature"])
+            f = int(self.ds.used_feature_map[real_f])
+            if f < 0:
+                continue
+            mapper = self.mappers[f]
+            thr_bin = int(mapper.values_to_bins(
+                np.asarray([float(node["threshold"])]))[0])
+            info = leaves[lid]
+            b = self._forced_split_info(info, f, thr_bin)
+            if min(b["left_c"], b["right_c"]) < 1:
+                continue
+            right_leaf = self._commit_split(tree, leaves, lid, info, b,
+                                            feature_mask, grad, hess)
+            if "left" in node:
+                q.append((lid, node["left"]))
+            if "right" in node:
+                q.append((right_leaf, node["right"]))
+
+    def _commit_split(self, tree: Tree, leaves: Dict, best_leaf: int,
+                      info: "_LeafInfo", b: dict, feature_mask, grad,
+                      hess) -> int:
+        """Apply one chosen split: tree node, partition, CEGB marking,
+        children (smaller-histogram + parent-minus-subtract). Shared by
+        gain-driven growth and forced splits. Returns the right leaf id."""
+        cfg = self.cfg
+        f = b["feature"]
+        mapper = self.mappers[f]
+        mt_c = _MISSING_CODE_TO_C[mapper.missing_type]
+
+        real_feature = int(self.ds.real_feature_idx[f])
+        if b["is_cat"]:
+            cat_bins = b["cat_bins"]
+            cats = [mapper.bin_2_categorical[bb] for bb in cat_bins
+                    if bb < len(mapper.bin_2_categorical)]
+            right_leaf = tree.split_categorical(
+                best_leaf, f, real_feature, cat_bins, cats,
+                b["left_output"], b["right_output"], b["left_c"],
+                b["right_c"], b["gain"], mt_c,
+                default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+            cat_bitset = np.zeros(8, np.uint32)
+            for bb in cat_bins:
+                cat_bitset[bb // 32] |= np.uint32(1) << np.uint32(bb % 32)
+        else:
+            thr_double = mapper.bin_to_value(b["threshold"])
+            right_leaf = tree.split(
+                best_leaf, f, real_feature, b["threshold"], thr_double,
+                b["left_output"], b["right_output"], b["left_c"],
+                b["right_c"], b["gain"], mt_c, b["default_left"],
+                default_bin=mapper.default_bin, num_bin=mapper.num_bin)
+            cat_bitset = np.zeros(8, np.uint32)
+
+        padded = _pow2_pad(info.count, cfg.tpu_min_pad)
+        self.indices, lcnt_dev = split_partition(
+            self.indices, self.bins_dev[:, f], jnp.int32(info.begin),
+            jnp.int32(info.count), padded, jnp.int32(b["threshold"]),
+            jnp.asarray(b["default_left"]), jnp.int32(mt_c),
+            jnp.int32(mapper.default_bin), jnp.int32(mapper.num_bin),
+            jnp.asarray(b["is_cat"]), jnp.asarray(cat_bitset))
+        left_count = int(np.asarray(lcnt_dev))
+        right_count = info.count - left_count
+        self._cegb_commit(f, info.begin, info.count)
+
+        lmin, lmax = info.min_constraint, info.max_constraint
+        rmin, rmax = info.min_constraint, info.max_constraint
+        mono = int(self.meta["monotone"][f]) if self._monotone_any else 0
+        if mono != 0:
+            mid = (b["left_output"] + b["right_output"]) / 2.0
+            if mono > 0:
+                lmax = min(lmax, mid)
+                rmin = max(rmin, mid)
+            else:
+                lmin = max(lmin, mid)
+                rmax = min(rmax, mid)
+        left = _LeafInfo(info.begin, left_count, b["left_g"],
+                         b["left_h"], info.depth + 1, lmin, lmax)
+        right = _LeafInfo(info.begin + left_count, right_count,
+                          b["right_g"], b["right_h"], info.depth + 1,
+                          rmin, rmax)
+
+        if left_count <= right_count:
+            smaller, larger = left, right
+        else:
+            smaller, larger = right, left
+        if tree.num_leaves < cfg.num_leaves:
+            smaller.hist = self._leaf_hist(smaller, grad, hess)
+            larger.hist = subtract_histogram(info.hist, smaller.hist)
+            smaller.best = self._find_best(smaller, feature_mask)
+            larger.best = self._find_best(larger, feature_mask)
+        leaves[best_leaf] = left
+        leaves[right_leaf] = right
+        info.hist = None
+        return right_leaf
+
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_indices: Optional[np.ndarray] = None,
               bag_count: Optional[int] = None) -> Tuple[Tree, Dict]:
@@ -181,8 +384,9 @@ class SerialTreeLearner:
         tree = Tree(cfg.num_leaves)
         leaves: Dict[int, _LeafInfo] = {0: root}
         leaf_begin_count: Dict[int, Tuple[int, int]] = {}
+        self._apply_forced_splits(tree, leaves, grad, hess, feature_mask)
 
-        for _ in range(cfg.num_leaves - 1):
+        while tree.num_leaves < cfg.num_leaves:
             # pick max-gain leaf (Train loop, serial_tree_learner.cpp:201-224)
             best_leaf, best_gain = -1, 0.0
             for lid, info in leaves.items():
@@ -192,80 +396,8 @@ class SerialTreeLearner:
             if best_leaf < 0:
                 break
             info = leaves[best_leaf]
-            b = info.best
-            f = b["feature"]
-            mapper = self.mappers[f]
-            mt_c = _MISSING_CODE_TO_C[mapper.missing_type]
-
-            # --- tree update
-            real_feature = int(self.ds.real_feature_idx[f])
-            if b["is_cat"]:
-                cat_bins = b["cat_bins"]
-                cats = [mapper.bin_2_categorical[bb] for bb in cat_bins
-                        if bb < len(mapper.bin_2_categorical)]
-                right_leaf = tree.split_categorical(
-                    best_leaf, f, real_feature, cat_bins, cats,
-                    b["left_output"], b["right_output"], b["left_c"],
-                    b["right_c"], b["gain"], mt_c,
-                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
-                cat_bitset = np.zeros(8, np.uint32)
-                for bb in cat_bins:
-                    cat_bitset[bb // 32] |= np.uint32(1) << np.uint32(bb % 32)
-            else:
-                thr_double = mapper.bin_to_value(b["threshold"])
-                right_leaf = tree.split(
-                    best_leaf, f, real_feature, b["threshold"], thr_double,
-                    b["left_output"], b["right_output"], b["left_c"],
-                    b["right_c"], b["gain"], mt_c, b["default_left"],
-                    default_bin=mapper.default_bin, num_bin=mapper.num_bin)
-                cat_bitset = np.zeros(8, np.uint32)
-
-            # --- partition update
-            padded = _pow2_pad(info.count, cfg.tpu_min_pad)
-            self.indices, lcnt_dev = split_partition(
-                self.indices, self.bins_dev[:, f], jnp.int32(info.begin),
-                jnp.int32(info.count), padded, jnp.int32(b["threshold"]),
-                jnp.asarray(b["default_left"]), jnp.int32(mt_c),
-                jnp.int32(mapper.default_bin), jnp.int32(mapper.num_bin),
-                jnp.asarray(b["is_cat"]), jnp.asarray(cat_bitset))
-            left_count = int(np.asarray(lcnt_dev))
-            # partition and split-finder counts can differ only by numeric
-            # noise in f32 histogram counts; trust the partition
-            right_count = info.count - left_count
-
-            # --- child leaf infos + monotone constraint propagation
-            # (serial_tree_learner.cpp:826-851)
-            lmin, lmax = info.min_constraint, info.max_constraint
-            rmin, rmax = info.min_constraint, info.max_constraint
-            mono = int(self.meta["monotone"][f]) if self._monotone_any else 0
-            if mono != 0:
-                mid = (b["left_output"] + b["right_output"]) / 2.0
-                if mono > 0:
-                    lmax = min(lmax, mid)
-                    rmin = max(rmin, mid)
-                else:
-                    lmin = max(lmin, mid)
-                    rmax = min(rmax, mid)
-            left = _LeafInfo(info.begin, left_count, b["left_g"],
-                             b["left_h"], info.depth + 1, lmin, lmax)
-            right = _LeafInfo(info.begin + left_count, right_count,
-                              b["right_g"], b["right_h"], info.depth + 1,
-                              rmin, rmax)
-
-            # --- histogram: construct smaller, subtract for larger
-            if left_count <= right_count:
-                smaller, larger = left, right
-            else:
-                smaller, larger = right, left
-            can_split_more = (tree.num_leaves < cfg.num_leaves)
-            if can_split_more:
-                smaller.hist = self._leaf_hist(smaller, grad, hess)
-                larger.hist = subtract_histogram(info.hist, smaller.hist)
-                smaller.best = self._find_best(smaller, feature_mask)
-                larger.best = self._find_best(larger, feature_mask)
-            leaves[best_leaf] = left
-            leaves[right_leaf] = right
-            info.hist = None  # free parent histogram
+            self._commit_split(tree, leaves, best_leaf, info, info.best,
+                               feature_mask, grad, hess)
 
         leaf_begin_count = {lid: (inf.begin, inf.count)
                             for lid, inf in leaves.items()}

@@ -1,0 +1,117 @@
+"""CEGB penalties + forced splits (reference test_basic.py:220-282
+acceptance pattern; serial_tree_learner.cpp:488-568, :597-755)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.random((1000, 5))
+    X[:, [1, 3]] = 0
+    y = rng.random(1000)
+    return X, y
+
+
+def _model_txt(params, X, y, rounds=10):
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(rounds):
+        bst.update()
+    return bst.model_to_string()
+
+
+def test_cegb_affects_behavior():
+    X, y = _data()
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 31}
+    basetxt = _model_txt(base, X, y)
+    cases = [{"cegb_penalty_feature_coupled": [50, 100, 10, 25, 30]},
+             {"cegb_penalty_feature_lazy": [1, 2, 3, 4, 5]},
+             {"cegb_penalty_split": 1}]
+    for case in cases:
+        txt = _model_txt(dict(base, **case), X, y)
+        assert txt != basetxt, case
+
+
+def test_cegb_scaling_equalities():
+    X, y = _data()
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 31}
+    pairs = [({"cegb_penalty_feature_coupled": [1, 2, 1, 2, 1]},
+              {"cegb_penalty_feature_coupled": [0.5, 1, 0.5, 1, 0.5],
+               "cegb_tradeoff": 2}),
+             ({"cegb_penalty_feature_lazy": [0.01, 0.02, 0.03, 0.04, 0.05]},
+              {"cegb_penalty_feature_lazy": [0.005, 0.01, 0.015, 0.02,
+                                             0.025], "cegb_tradeoff": 2}),
+             ({"cegb_penalty_split": 1},
+              {"cegb_penalty_split": 2, "cegb_tradeoff": 0.5})]
+    for p1, p2 in pairs:
+        t1 = _model_txt(dict(base, **p1), X, y)
+        t2 = _model_txt(dict(base, **p2), X, y)
+        # strip the parameter dump: tree structures must be identical
+        s1 = t1.split("parameters")[0]
+        s2 = t2.split("parameters")[0]
+        assert s1 == s2, (p1, p2)
+
+
+def test_forced_splits_applied():
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2000, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    forced = {"feature": 2, "threshold": 0.25,
+              "left": {"feature": 3, "threshold": -0.5}}
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as fh:
+        json.dump(forced, fh)
+        path = fh.name
+    try:
+        params = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+                  "forcedsplits_filename": path}
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(3):
+            bst.update()
+        g = bst._gbdt
+        g.materialized_models()
+        for t in g.models:
+            # the ROOT split of every tree is the forced (feature 2)
+            assert int(t.split_feature[0]) == 2
+            # its left child splits on feature 3
+            lc = int(t.left_child[0])
+            if lc >= 0:
+                assert int(t.split_feature[lc]) == 3
+        # quality: remaining splits still learn the signal
+        p = bst.predict(X)
+        assert np.isfinite(p).all()
+    finally:
+        os.unlink(path)
+
+
+def test_histogram_pool_budget_changes_store():
+    """histogram_pool_size (feature_histogram.hpp:654-829): a tight
+    budget flips the device histogram store to bf16 — training still
+    works and memory halves."""
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((3000, 40)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 31,
+              "max_bin": 63, "histogram_pool_size": 1.0,
+              "tpu_grow_mode": "leafwise"}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    p = bst.predict(X[:200])
+    assert np.isfinite(p).all()
+    # and an unconstrained run differs only within bf16 noise
+    params2 = dict(params, histogram_pool_size=-1.0)
+    ds2 = lgb.Dataset(X, label=y, params=params2).construct()
+    bst2 = lgb.Booster(params=params2, train_set=ds2)
+    for _ in range(3):
+        bst2.update()
+    p2 = bst2.predict(X[:200])
+    assert np.abs(p - p2).mean() < 0.05
