@@ -34,6 +34,21 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# persistent XLA compilation cache: repeat bench runs (and real users'
+# repeat processes) skip the multi-minute warmup compiles
+import jax  # noqa: E402
+
+_cache = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+try:
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+except Exception:
+    pass
+
 import lightgbm_tpu as lgb  # noqa: E402
 
 BASELINE_S = 238.5       # docs/Experiments.rst:106 (CPU, 16 threads)

@@ -177,6 +177,8 @@ class Dataset:
                          else list(self.feature_name))
         raw_cats = (None if self.categorical_feature in ("auto", None)
                     else list(self.categorical_feature))
+        sparse_in = (hasattr(self.data, "tocsc")
+                     and not isinstance(self.data, np.ndarray))
         if hasattr(self.data, "values") and hasattr(self.data, "columns"):
             mat, pd_names, pd_cats, pd_categories = \
                 _data_from_pandas(self.data)
@@ -185,6 +187,8 @@ class Dataset:
             if raw_cats is None and pd_cats:
                 raw_cats = pd_cats
             self.pandas_categorical = pd_categories or None
+        elif sparse_in:
+            mat = self.data   # CSR/CSC stays sparse (from_sparse ingest)
         else:
             mat = _to_matrix(self.data)
         cats = None
@@ -200,7 +204,9 @@ class Dataset:
                     cats.append(feature_names.index(c))
                 else:
                     cats.append(int(c))
-        self._handle = _CoreDataset.from_matrix(
+        maker = (_CoreDataset.from_sparse if sparse_in
+                 else _CoreDataset.from_matrix)
+        self._handle = maker(
             mat, label=self.label, config=cfg, weight=self.weight,
             group=self.group, init_score=self.init_score,
             feature_names=feature_names, categorical_feature=cats,

@@ -92,6 +92,47 @@ class Metadata:
         return len(self.query_boundaries) - 1
 
 
+def _cat_set_from(cfg, categorical_feature):
+    """Union of the categorical_feature argument and the config string
+    (reference config categorical_feature handling)."""
+    cat_set = set(int(c) for c in (categorical_feature or []))
+    if cfg.categorical_feature:
+        for tok in str(cfg.categorical_feature).split(","):
+            tok = tok.strip()
+            if tok.startswith("name:"):
+                continue
+            if tok:
+                cat_set.add(int(tok))
+    return cat_set
+
+
+def _finalize_used_features(self, cfg, f):
+    """used-feature map + per-used monotone/penalty arrays (shared by the
+    dense and sparse constructors)."""
+    self.used_feature_map = np.full(f, -1, dtype=np.int32)
+    used = [j for j in range(f) if not self.mappers[j].is_trivial]
+    for col_idx, j in enumerate(used):
+        self.used_feature_map[j] = col_idx
+    self.real_feature_idx = np.asarray(used, dtype=np.int32)
+    mono = np.zeros(f, dtype=np.int8)
+    for i, v in enumerate(cfg.monotone_constraints[:f]):
+        mono[i] = np.int8(v)
+    self.monotone_constraints = mono[self.real_feature_idx] \
+        if len(used) else np.zeros(0, dtype=np.int8)
+    pen = np.ones(f, dtype=np.float64)
+    for i, v in enumerate(cfg.feature_contri[:f]):
+        pen[i] = float(v)
+    self.feature_penalty = pen[self.real_feature_idx] \
+        if len(used) else np.zeros(0, dtype=np.float64)
+    for j in self.real_feature_idx:
+        m = self.mappers[j]
+        if m.bin_type == BIN_CATEGORICAL and m.num_bin > 256:
+            warnings.warn(
+                f"categorical feature {j} has {m.num_bin} bins; only the "
+                "256 most frequent categories are split candidates "
+                "(device bitset limit)")
+
+
 class Dataset:
     """Host-side binned dataset (reference `Dataset`, `dataset.h:250+`).
 
@@ -250,6 +291,97 @@ class Dataset:
         self.bins = bins
         self._maybe_bundle(cfg, reference)
 
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_sparse(cls, data, label: Optional[Sequence] = None,
+                    config: Optional[Config] = None,
+                    weight: Optional[Sequence] = None,
+                    group: Optional[Sequence] = None,
+                    init_score: Optional[Sequence] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_feature: Optional[Sequence[int]] = None,
+                    reference: Optional["Dataset"] = None) -> "Dataset":
+        """Build a binned dataset from a scipy CSR/CSC matrix WITHOUT a
+        dense float intermediate (the reference's CSR/CSC ingest,
+        `LGBM_DatasetCreateFromCSR/CSC`, c_api.h:52-256; our analogue of
+        `PushOneRow` keeps only per-column nonzeros + the uint8 output).
+
+        Bin finding runs on each column's nonzeros (zeros are implied by
+        count, exactly like the dense path's zero filter); the full
+        ingest scatters per-column nonzero bins over a zero-bin
+        background, so peak memory is nnz + the uint8 binned matrix.
+        """
+        cfg = config or Config()
+        csc = data.tocsc()
+        n, f = csc.shape
+        self = cls()
+        self.num_data = n
+        self.num_total_features = f
+        self.metadata = Metadata(n)
+        self.max_bin = cfg.max_bin
+        self.min_data_in_bin = cfg.min_data_in_bin
+        self.use_missing = cfg.use_missing
+        self.zero_as_missing = cfg.zero_as_missing
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(f)])
+        cat_set = _cat_set_from(cfg, categorical_feature)
+
+        if reference is not None:
+            self.mappers = reference.mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_feature_idx = reference.real_feature_idx
+            self.max_bin = reference.max_bin
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+            self.feature_names = reference.feature_names
+        else:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_cnt = min(n, max(cfg.bin_construct_sample_cnt, 1))
+            srows = (np.sort(rng.choice(n, sample_cnt, replace=False))
+                     if sample_cnt < n else None)
+            self.mappers = []
+            for j in range(f):
+                lo, hi = csc.indptr[j], csc.indptr[j + 1]
+                vals = np.asarray(csc.data[lo:hi], np.float64)
+                if srows is not None:
+                    rows_j = csc.indices[lo:hi]
+                    sel = np.isin(rows_j, srows, assume_unique=False)
+                    vals = vals[sel]
+                vals = vals[~((vals >= -1e-35) & (vals <= 1e-35))]
+                m = BinMapper()
+                bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+                m.find_bin(vals, total_sample_cnt=sample_cnt,
+                           max_bin=cfg.max_bin,
+                           min_data_in_bin=cfg.min_data_in_bin,
+                           min_split_data=cfg.min_data_in_leaf,
+                           bin_type=bt, use_missing=cfg.use_missing,
+                           zero_as_missing=cfg.zero_as_missing)
+                self.mappers.append(m)
+            _finalize_used_features(self, cfg, f)
+
+        used = self.real_feature_idx
+        max_nb = max((self.mappers[j].num_bin for j in used), default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        bins = np.zeros((n, len(used)), dtype=dtype)
+        for col_idx, j in enumerate(used):
+            m = self.mappers[j]
+            zero_bin = int(m.values_to_bins(np.zeros(1))[0])
+            if zero_bin:
+                bins[:, col_idx] = zero_bin
+            lo, hi = csc.indptr[j], csc.indptr[j + 1]
+            if hi > lo:
+                nz_bins = m.values_to_bins(
+                    np.asarray(csc.data[lo:hi], np.float64))
+                bins[csc.indices[lo:hi], col_idx] = nz_bins.astype(dtype)
+        self.bins = bins
+        self._maybe_bundle(cfg, reference)
         if label is not None:
             self.metadata.set_label(label)
         self.metadata.set_weight(weight)

@@ -11,6 +11,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _make(n=3000, f=6, seed=0):
     rng = np.random.default_rng(seed)

@@ -96,7 +96,7 @@ def test_histogram_pool_budget_changes_store():
     budget flips the device histogram store to bf16 — training still
     works and memory halves."""
     rng = np.random.default_rng(2)
-    X = rng.standard_normal((3000, 40)).astype(np.float32)
+    X = rng.standard_normal((2000, 24)).astype(np.float32)
     y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
     params = {"objective": "binary", "verbosity": -1, "num_leaves": 31,
               "max_bin": 63, "histogram_pool_size": 1.0,

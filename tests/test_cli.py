@@ -15,6 +15,8 @@ from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.loader import DatasetLoader
 from lightgbm_tpu.io.parser import create_parser, detect_format, parse_dense
 
+pytestmark = pytest.mark.slow
+
 REF_EXAMPLES = "/root/reference/examples"
 BINARY_DIR = os.path.join(REF_EXAMPLES, "binary_classification")
 HAS_REF = os.path.isdir(BINARY_DIR)

@@ -104,7 +104,7 @@ def test_bundled_valid_sets_and_metrics():
     res = {}
     bst = lgb.Booster(params=params, train_set=ds)
     bst.add_valid(vs, "v")
-    for _ in range(6):
+    for _ in range(8):
         bst.update()
     out = bst.eval_valid()
     assert out and np.isfinite(out[0][2])

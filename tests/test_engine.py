@@ -8,6 +8,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _binary_data(n=1000, f=10, seed=0):
     rng = np.random.RandomState(seed)

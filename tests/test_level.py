@@ -13,6 +13,8 @@ import pytest
 import jax
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _problem(n=20000, f=10, seed=0, cat_col=3):
     rng = np.random.RandomState(seed)

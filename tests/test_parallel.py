@@ -16,6 +16,8 @@ from lightgbm_tpu.io.dataset import Dataset
 from lightgbm_tpu.models.gbdt import GBDT
 from lightgbm_tpu.parallel.data_parallel import DataParallelTreeLearner
 
+pytestmark = pytest.mark.slow
+
 
 def _make_problem(n=1200, f=8, seed=3, classification=True):
     rng = np.random.default_rng(seed)

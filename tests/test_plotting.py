@@ -7,6 +7,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def booster():

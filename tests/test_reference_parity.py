@@ -1,0 +1,154 @@
+"""Numeric parity against the ACTUAL reference implementation.
+
+Builds the reference CLI out-of-tree (cmake into .refbuild/, skipped when
+the toolchain or sources are unavailable), trains both implementations on
+the reference examples with identical configs, and asserts:
+- training metric curves agree within tolerance
+- the reference LOADS our model file and predicts with it (cross-load)
+(VERDICT r2 item 7; the reference's own cross-layer net is
+tests/python_package_test/test_consistency.py.)
+"""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+REF = "/root/reference"
+BUILD = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".refbuild")
+CLI = os.path.join(BUILD, "lightgbm")
+
+
+def _ensure_cli():
+    if os.path.isfile(CLI):
+        return True
+    if not (os.path.isdir(REF) and shutil.which("cmake")
+            and shutil.which("make")):
+        return False
+    os.makedirs(BUILD, exist_ok=True)
+    try:
+        subprocess.run(
+            ["cmake", REF, "-DCMAKE_BUILD_TYPE=Release",
+             f"-DCMAKE_RUNTIME_OUTPUT_DIRECTORY={BUILD}",
+             f"-DCMAKE_LIBRARY_OUTPUT_DIRECTORY={BUILD}"],
+            cwd=BUILD, check=True, capture_output=True, timeout=300)
+        subprocess.run(["make", "-j8", "lightgbm"], cwd=BUILD, check=True,
+                       capture_output=True, timeout=900)
+    except Exception:
+        return False
+    return os.path.isfile(CLI)
+
+
+@pytest.fixture(scope="session")
+def ref_cli():
+    """Build the reference CLI lazily (NOT at collection time — the fast
+    gate deselects these tests and must not pay the cmake+make build)."""
+    if not _ensure_cli():
+        pytest.skip("reference CLI unavailable")
+    return CLI
+
+
+requires_cli = pytest.mark.usefixtures("ref_cli")
+
+
+def _load_tsv(path):
+    raw = np.loadtxt(path, delimiter="\t")
+    return raw[:, 1:], raw[:, 0]
+
+
+def _ref_train(tmpdir, conf_lines, train_path, model_name="ref_model.txt"):
+    conf = os.path.join(tmpdir, "train.conf")
+    model = os.path.join(tmpdir, model_name)
+    with open(conf, "w") as fh:
+        fh.write("\n".join(conf_lines + [f"data = {train_path}",
+                                         f"output_model = {model}"]))
+    out = subprocess.run([CLI, f"config={conf}"], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return model, out.stdout + out.stderr
+
+
+@requires_cli
+@pytest.mark.parametrize("task,objective,metric,tol", [
+    ("binary_classification", "binary", "binary_logloss", 0.02),
+    ("regression", "regression", "l2", 0.05),
+])
+def test_metric_curves_match_reference(tmp_path, task, objective, metric,
+                                       tol):
+    train_path = f"{REF}/examples/{task}/{task.split('_')[0]}.train"
+    if not os.path.isfile(train_path):
+        train_path = f"{REF}/examples/{task}/regression.train"
+    X, y = _load_tsv(train_path)
+    rounds = 15
+    conf = [f"objective = {objective}", "num_leaves = 31",
+            "learning_rate = 0.1", "num_trees = %d" % rounds,
+            f"metric = {metric}", "metric_freq = 1", "is_training_metric = true",
+            "min_data_in_leaf = 20", "verbosity = 1",
+            "is_enable_sparse = false"]
+    _, log = _ref_train(str(tmp_path), conf, train_path)
+    ref_curve = []
+    for line in log.splitlines():
+        if "training" in line and ":" in line:
+            try:
+                ref_curve.append(float(line.rsplit(":", 1)[1].strip()))
+            except ValueError:
+                pass
+    assert ref_curve, log[-2000:]
+
+    params = {"objective": objective, "num_leaves": 31,
+              "learning_rate": 0.1, "metric": metric,
+              "min_data_in_leaf": 20, "verbosity": -1}
+    # the reference CLI auto-loads .init sidecars as init scores
+    init = None
+    if os.path.isfile(train_path + ".init"):
+        init = np.loadtxt(train_path + ".init")
+    ds = lgb.Dataset(X, label=y, params=params,
+                     init_score=init).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    ours = []
+    for _ in range(rounds):
+        bst.update()
+        ours.append(bst.eval_train()[0][2])
+    k = min(len(ref_curve), len(ours))
+    ref_c = np.asarray(ref_curve[:k])
+    our_c = np.asarray(ours[:k])
+    # relative agreement of the training curves
+    rel = np.abs(ref_c - our_c) / np.maximum(np.abs(ref_c), 1e-9)
+    assert rel.max() < tol, (ref_c, our_c)
+
+
+@requires_cli
+def test_reference_loads_our_model(tmp_path):
+    """Model-file cross-loading: the reference CLI predicts with a model
+    file WE wrote (gbdt_model_text.cpp round-trip compatibility)."""
+    task = "binary_classification"
+    train_path = f"{REF}/examples/{task}/binary.train"
+    test_path = f"{REF}/examples/{task}/binary.test"
+    X, y = _load_tsv(train_path)
+    params = {"objective": "binary", "num_leaves": 31,
+              "learning_rate": 0.1, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(10):
+        bst.update()
+    model = str(tmp_path / "our_model.txt")
+    bst.save_model(model)
+    outpath = str(tmp_path / "preds.txt")
+    conf = str(tmp_path / "pred.conf")
+    with open(conf, "w") as fh:
+        fh.write("\n".join([
+            "task = predict", f"data = {test_path}",
+            f"input_model = {model}", f"output_result = {outpath}"]))
+    out = subprocess.run([CLI, f"config={conf}"], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    ref_preds = np.loadtxt(outpath)
+    Xt, _ = _load_tsv(test_path)
+    our_preds = bst.predict(Xt)
+    np.testing.assert_allclose(ref_preds, our_preds, rtol=1e-4, atol=1e-5)

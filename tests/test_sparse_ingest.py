@@ -1,0 +1,57 @@
+"""CSR/CSC host ingest without a dense float intermediate (reference
+LGBM_DatasetCreateFromCSR/CSC, c_api.h:52-256; VERDICT r2 item 8)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+
+def _sparse_problem(n=8000, f=200, density=0.01, seed=5):
+    rng = np.random.default_rng(seed)
+    nnz = int(n * f * density)
+    rows = rng.integers(0, n, nnz)
+    cols = rng.integers(0, f, nnz)
+    vals = rng.standard_normal(nnz)
+    X = scipy_sparse.coo_matrix((vals, (rows, cols)),
+                                shape=(n, f)).tocsr()
+    # label depends on a few columns
+    d = np.asarray(X[:, :3].todense())
+    y = (d[:, 0] + d[:, 1] - d[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def test_csr_construct_and_train():
+    X, y = _sparse_problem()
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "verbosity": -1, "tpu_grow_mode": "leafwise"}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    d = ds._handle
+    assert d.bins is not None and d.bins.dtype == np.uint8
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    p = bst.predict(np.asarray(X[:200].todense()))
+    assert np.isfinite(p).all()
+
+
+def test_csr_matches_dense():
+    X, y = _sparse_problem(n=3000, f=40, density=0.05)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "verbosity": -1, "enable_bundle": False,
+              "tpu_grow_mode": "leafwise",
+              "bin_construct_sample_cnt": 100000}
+    ds_s = lgb.Dataset(X, label=y, params=params).construct()
+    ds_d = lgb.Dataset(np.asarray(X.todense()), label=y,
+                       params=params).construct()
+    np.testing.assert_array_equal(ds_s._handle.bins, ds_d._handle.bins)
+
+
+def test_csc_input_also_works():
+    X, y = _sparse_problem(n=2000, f=30, density=0.05)
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    ds = lgb.Dataset(X.tocsc(), label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+    assert bst._gbdt.iter >= 0

@@ -5,6 +5,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _binary_data(n=1500, f=8, seed=0):
     rng = np.random.RandomState(seed)
