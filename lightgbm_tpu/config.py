@@ -375,7 +375,7 @@ class Config:
     # so the executed-split count grows) before falling back
     tpu_level_spec: float = 6.0
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
-    tpu_chunk: int = 512                 # aligned-pipeline rows per chunk
+    tpu_chunk: int = 0                   # aligned rows/chunk (0 = auto)
     # run the aligned pipeline's Pallas kernels in interpret mode (CPU
     # testing only — orders of magnitude slower than the TPU kernels)
     tpu_aligned_interpret: bool = False

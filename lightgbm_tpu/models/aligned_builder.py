@@ -101,7 +101,14 @@ class AlignedEngine:
         self.objective = objective
         self.cfg = learner.cfg
         self.interpret = interpret
-        self.C = int(getattr(self.cfg, "tpu_chunk", 512))
+        C = int(getattr(self.cfg, "tpu_chunk", 0))
+        if C <= 0:
+            # 512 measured best on v5e at 10.5M rows: 256 halves the
+            # permutation matmul but doubles grid/DMA/glue fixed costs
+            # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
+            # NC at 65k chunks
+            C = 512
+        self.C = C
         bins = np.asarray(learner.ds.bins)
         if learner.num_features != learner.num_real_features:
             pad = learner.num_features - learner.num_real_features
@@ -135,6 +142,14 @@ class AlignedEngine:
         self._iter_tag = 0
 
     # ------------------------------------------------------------------
+    def row_scores_dev(self):
+        """Training scores in ROW order as a DEVICE array (for objectives
+        whose gradients are not pointwise — ranking needs query-grouped
+        rows, so gradients are computed in row order and re-ingested)."""
+        fn = self._program("mat", self._materialize_program)
+        return fn(self.rec, self.cnts)
+
+    # ------------------------------------------------------------------
     def _grad_lanes(self, rec):
         """g/h record lanes from the score/label(/weight) lanes —
         evaluated in PERMUTED row order (pointwise objectives only)."""
@@ -149,9 +164,11 @@ class AlignedEngine:
         return rec
 
     # ------------------------------------------------------------------
-    def _build_program(self):
+    def _build_program(self, external_grads: bool = False):
         """The jitted per-iteration program: gradients + speculative tree
-        build. Returns (rec_final, cnts_final, AlignedSpec)."""
+        build. Returns (rec_final, cnts_final, AlignedSpec). With
+        external_grads the g/h lanes come from row-order arrays gathered
+        by the rid lane instead of the pointwise in-lane computation."""
         lr = self.learner
         cfg = self.cfg
         C, NC, S = self.C, self.NC, self.S
@@ -297,8 +314,14 @@ class AlignedEngine:
 
         eval_all = jax.vmap(eval_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0))
 
-        def build(rec, cnts_pc, feature_mask_f32, scale_in):
-            rec = self._grad_lanes(rec)
+        def build(rec, cnts_pc, feature_mask_f32, scale_in,
+                  g_rows=None, h_rows=None):
+            if external_grads:
+                rid = jnp.clip(rec[:, ln["rid"], :], 0, self.n - 1)
+                rec = rec.at[:, ln["grad"], :].set(_i32(g_rows[rid]))
+                rec = rec.at[:, ln["hess"], :].set(_i32(h_rows[rid]))
+            else:
+                rec = self._grad_lanes(rec)
 
             # ---------- root ----------
             root_slots = jnp.zeros(NC, jnp.int32)
@@ -542,17 +565,36 @@ class AlignedEngine:
                 bestI = jnp.where(exists2[:, None], bI, bestI)
                 bestB = jnp.where(exists2[:, None], bB, bestB)
 
-                commit, need2, ncommit = device_replay(
-                    execF, execI, bestF[:, BF_GAIN], done + k)
+                # While fewer than L-1 splits exist, the replay would pop
+                # EVERY candidate before exhausting its commit budget, so
+                # need = all positive tips without running it (the real
+                # replay always runs before the loop can exit: the exit
+                # needs an empty need, impossible in this branch).
+                def full_replay(_):
+                    return device_replay(execF, execI, bestF[:, BF_GAIN],
+                                         done + k)
+
+                def all_needed(_):
+                    nd = (bestF[:, BF_GAIN] > 0.0) & exists2
+                    return (jnp.zeros(Sm1 + 1, bool), nd, jnp.int32(0))
+
+                commit, need2, ncommit = lax.cond(
+                    done + k < Lm1_commit, all_needed, full_replay,
+                    operand=None)
 
                 return (done + k, rec, cnts_pc, leafF, leafI, bestF, bestI,
                         bestB, hist_store, execF, execI, execB, need2,
                         commit, ncommit, rounds + 1)
 
             (n_exec, rec, cnts_pc, leafF, leafI, bestF, bestI, bestB,
-             _, execF, execI, execB, need_end, commit, ncommit, rounds
-             ) = lax.while_loop(cond, body, state)
-            exact = ~jnp.any(need_end)
+             _, execF, execI, execB, need_end, _commit_c, _ncommit_c,
+             rounds) = lax.while_loop(cond, body, state)
+            # authoritative final replay: the in-loop replay may have been
+            # skipped on the last round (all_needed shortcut), and a tree
+            # that stops growing early must still commit its real splits
+            commit, need_fin, ncommit = device_replay(
+                execF, execI, bestF[:, BF_GAIN], n_exec)
+            exact = ~jnp.any(need_fin)
 
             # ---- committed cover value per slot (host _value_map twin,
             # the reference's leaf outputs applied through the finer
@@ -600,13 +642,24 @@ class AlignedEngine:
         return fn
 
     def train_iter(self, scale: float,
-                   feature_mask: Optional[np.ndarray] = None):
+                   feature_mask: Optional[np.ndarray] = None,
+                   grads=None):
         """One boosting iteration: gradients + tree build + score-lane
-        update. Returns (TreeRecord host, exact: bool)."""
-        fn = self._program("build", self._build_program, donate=(0,))
+        update. Returns ((spec, ncommit) | None, exact). `grads` =
+        (g_rows, h_rows) device arrays for non-pointwise objectives."""
         fmask = self.learner._fmask_arr(feature_mask)
-        rec, cnts, spec, exact_dev, ncommit_dev = fn(
-            self.rec, self.cnts, fmask, jnp.float32(scale))
+        if grads is not None:
+            fn = self._program(
+                "build_ext",
+                lambda: self._build_program(external_grads=True),
+                donate=(0,))
+            rec, cnts, spec, exact_dev, ncommit_dev = fn(
+                self.rec, self.cnts, fmask, jnp.float32(scale),
+                grads[0], grads[1])
+        else:
+            fn = self._program("build", self._build_program, donate=(0,))
+            rec, cnts, spec, exact_dev, ncommit_dev = fn(
+                self.rec, self.cnts, fmask, jnp.float32(scale))
         # the records were donated: the physical layout advances either
         # way (harmless — the next root re-reads everything); the SCORE
         # lane was updated on device only when the replay was exact. The

@@ -850,6 +850,11 @@ class DeviceTreeLearner:
             return False
         return (self.parallel_mode == "serial"
                 and not self.bundled
+                # packed-prefetch limits: 16-bit destination chunk ids
+                # (NC <= 65535 at chunk 512) and 8-bit word selectors
+                # (features <= 1020)
+                and self.n <= 512 * 65000
+                and self.num_features <= 1020
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8
                 and self.num_features > 0
@@ -858,7 +863,11 @@ class DeviceTreeLearner:
                 and not bool(np.any(self.meta["bin_type"] != 0))
                 and objective is not None
                 and objective.num_model_per_iteration == 1
-                and objective.point_grad_fn() is not None)
+                # non-pointwise objectives pay a row-order gradient
+                # round-trip (materialize + gather ~100ms); worth it only
+                # when the tree build dominates
+                and (objective.point_grad_fn() is not None
+                     or self.n >= 4_000_000))
 
     def aligned_engine(self, objective, init_row_scores=None):
         """The persistent AlignedEngine for (this learner, objective)."""

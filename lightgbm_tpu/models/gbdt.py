@@ -428,7 +428,15 @@ class GBDT:
                 init_row_scores=np.asarray(self.train_score.score[0]))
             self._aligned_eng_ref = eng
         fmask = self.learner.feature_mask()
-        out, exact = eng.train_iter(self.shrinkage_rate, fmask)
+        grads = None
+        if eng._pgrad is None:
+            # non-pointwise objective (ranking): gradients need ROW order
+            # — materialize scores on device, compute, re-ingest by rid
+            scores = eng.row_scores_dev()
+            gd, hd = self.objective.get_gradients(scores[None, :])
+            grads = (gd[0], hd[0])
+        out, exact = eng.train_iter(self.shrinkage_rate, fmask,
+                                    grads=grads)
         if not exact:
             # speculation too shallow for an exact leaf-wise replay:
             # grow this tree with the sequential leaf-wise builder and

@@ -64,6 +64,7 @@ R_SHIFT = 8        # bits 8..12  shift within word (0/8/16/24)
 R_DL = 13          # bit 13      default_left
 R_MT = 14          # bits 14..15 missing type
 R_COPY = 16        # bit 16      copy-through (unsplit block)
+R_WSEL = 17        # bits 17..24 split word lane of the block
 # route word 2: default_bin | num_bin << 16
 # meta word: cnt | first << 20 | last << 21
 
@@ -135,7 +136,7 @@ def _goes_left(binv, r1, r2, valid):
     return out != 0
 
 
-def _move_kernel(r1_ref, r2_ref, bl_ref, br_ref, meta_ref, wsel_ref,
+def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  hslot_ref, rec_ref, out_ref, hist_ref, stag, fbuf,
                  cur_ref, sems, *, chunk, w_pad, wcnt, num_features,
                  b_pad, group, dummy):
@@ -224,6 +225,9 @@ def _move_kernel(r1_ref, r2_ref, bl_ref, br_ref, meta_ref, wsel_ref,
 
     # ---- copy fast-path: unsplit blocks shift as whole chunks — one
     # buffered DMA to the prefetched direct destination (bl), no compute
+    bl_i = blbr_ref[i] & 0xFFFF
+    br_i = (blbr_ref[i] >> 16) & 0xFFFF
+
     @pl.when((is_copy != 0) & (cntv > 0))
     def _():
         for cp in range(2):
@@ -236,15 +240,15 @@ def _move_kernel(r1_ref, r2_ref, bl_ref, br_ref, meta_ref, wsel_ref,
                     wait_slot(slot)
                 fbuf[slot] = rec
                 pltpu.make_async_copy(
-                    fbuf.at[slot], out_ref.at[bl_ref[i]],
+                    fbuf.at[slot], out_ref.at[bl_i],
                     sems.at[slot]).start()
                 cur_ref[4 + slot] = 1
-                cur_ref[10 + slot] = bl_ref[i]
+                cur_ref[10 + slot] = bl_i
 
     # ---- split path
     @pl.when(is_copy == 0)
     def _():
-        wsel = wsel_ref[i]
+        wsel = (r1 >> R_WSEL) & 255
         word = rec[0, :]
         for wj in range(1, wcnt):
             word = jnp.where(wsel == wj, rec[wj, :], word)
@@ -335,8 +339,8 @@ def _move_kernel(r1_ref, r2_ref, bl_ref, br_ref, meta_ref, wsel_ref,
                                     jnp.minimum(cur_val - fl * C, C))
                     cur_ref[fl_slot] = fl + 1
 
-        flush_side(0, 2, bl_ref[i], new_l)
-        flush_side(1, 3, br_ref[i], new_r)
+        flush_side(0, 2, bl_i, new_l)
+        flush_side(1, 3, br_i, new_r)
 
         @pl.when(is_last != 0)
         def _():
@@ -360,6 +364,11 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
+    SMEM packing (the prefetch budget is 1 MB): wsel rides in r1 bits
+    R_WSEL..R_WSEL+7 (so features <= 1020) and basel/baser pack into one
+    16+16-bit word (so <= 65535 chunks) — callers must respect both
+    bounds (aligned_mode_ok does).
+
     records: [NC, W, C] i32; r1/r2/basel/baser/meta/wsel: [NC] i32
     per-chunk routing (see module docstring bit layouts; wsel = split
     word lane index of the chunk's block). hslots[i] packs the smaller
@@ -376,18 +385,20 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
     kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
                                wcnt=wcnt, num_features=num_features,
                                b_pad=b_pad, group=group, dummy=dummy)
+    r1p = r1 | (wsel << R_WSEL)
+    blbr = basel | (baser << 16)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=7,
+        num_scalar_prefetch=5,
         grid=(nc,),
         in_specs=[
             pl.BlockSpec((1, w_pad, chunk),
-                         lambda i, a, b, c, d, e, f, g: (i, 0, 0)),
+                         lambda i, a, b, c, d, e: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec((1, ngroups, 6, group * b_pad),
-                         lambda i, a, b, c, d, e, f, g:
-                         (g[i] & 0xFFFFFF, 0, 0, 0)),
+                         lambda i, a, b, c, d, e:
+                         (e[i] & 0xFFFFFF, 0, 0, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
@@ -407,7 +418,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 << 20, has_side_effects=True),
         interpret=interpret,
-    )(r1, r2, basel, baser, meta, wsel, hslots, records)
+    )(r1p, r2, blbr, meta, hslots, records)
     hist = hist.reshape(num_slots + 1, ngroups, 6, group, b_pad)
     hist = hist[:, :, :3] + hist[:, :, 3:]
     hist = jnp.moveaxis(hist, 2, 4)

@@ -102,3 +102,25 @@ def test_aligned_fallbacks_to_leafwise_when_ineligible():
                  extra={"bagging_fraction": 0.5, "bagging_freq": 1})
     assert bst._gbdt.iter == 3
     assert getattr(bst._gbdt, "_aligned_eng_ref", None) is None
+
+
+def test_aligned_early_stop_tree_commits():
+    """A tree whose gains dry up before num_leaves must still commit its
+    real splits and update the score lane (regression: the in-loop replay
+    shortcut must not zero the final commit set)."""
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = np.zeros((n, 3), np.float32)
+    X[:, 0] = (rng.random(n) > 0.5).astype(np.float32)
+    y = (X[:, 0] + 0.01 * rng.standard_normal(n) > 0.5).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "tpu_grow_mode": "aligned", "tpu_aligned_interpret": True,
+              "tpu_chunk": 256, "metric": "binary_logloss"}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    g = bst._gbdt
+    g.materialized_models()
+    assert g.models[0].num_leaves >= 2
+    assert g.eval_train()[0][2] < 0.55
