@@ -392,6 +392,9 @@ class Booster:
                fobj=None) -> bool:
         """One boosting iteration (reference basic.py:1846). Returns True if
         training finished (cannot split any more)."""
+        import time as _time
+        from .utils.log import debug as _log_debug
+        _t0 = _time.perf_counter()
         if fobj is not None:
             # custom gradients bypass the aligned engine's score lane:
             # sync the lazily-stale train scores and leave aligned mode
@@ -407,9 +410,18 @@ class Booster:
             grad = np.asarray(grad, np.float32).reshape(k, -1)
             hess = np.asarray(hess, np.float32).reshape(k, -1)
             self._model_gen += 1
-            return self._gbdt.train_one_iter(grad, hess)
+            out = self._gbdt.train_one_iter(grad, hess)
+            _log_debug("%.3fs elapsed, finished iteration %d"
+                       % (_time.perf_counter() - _t0,
+                          self._gbdt.num_iterations_trained))
+            return out
         self._model_gen += 1
-        return self._gbdt.train_one_iter()
+        out = self._gbdt.train_one_iter()
+        # reference logs per-iteration wall time (gbdt.cpp:285-288)
+        _log_debug("%.3fs elapsed, finished iteration %d"
+                   % (_time.perf_counter() - _t0,
+                      self._gbdt.num_iterations_trained))
+        return out
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()

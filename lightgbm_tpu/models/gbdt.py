@@ -122,6 +122,8 @@ class GBDT:
 
     def __init__(self, cfg: Config, train_data: Dataset,
                  objective: Optional[ObjectiveFunction] = None) -> None:
+        from ..utils.log import set_verbosity
+        set_verbosity(int(cfg.verbosity))
         self.cfg = cfg
         self.train_data = train_data
         self.num_data = train_data.num_data

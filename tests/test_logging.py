@@ -1,0 +1,45 @@
+"""Leveled logger (reference utils/log.h:37-48 + the C API log callback).
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import (register_callback, set_verbosity,
+                                    fatal, info, warning)
+
+
+def test_levels_and_callback():
+    lines = []
+    register_callback(lines.append)
+    try:
+        set_verbosity(0)
+        info("hidden")
+        warning("shown")
+        assert lines == ["[LightGBM-TPU] [Warning] shown"]
+        set_verbosity(1)
+        info("now shown")
+        assert lines[-1].endswith("now shown")
+        try:
+            fatal("boom")
+            raised = False
+        except RuntimeError:
+            raised = True
+        assert raised and lines[-1].endswith("boom")
+    finally:
+        register_callback(None)
+        set_verbosity(1)
+
+
+def test_booster_emits_iteration_debug():
+    lines = []
+    register_callback(lines.append)
+    try:
+        X = np.random.default_rng(0).standard_normal((300, 4))
+        y = (X[:, 0] > 0).astype(float)
+        params = {"objective": "binary", "verbosity": 2, "num_leaves": 7}
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        bst = lgb.Booster(params=params, train_set=ds)
+        bst.update()
+        assert any("finished iteration 1" in ln for ln in lines)
+    finally:
+        register_callback(None)
+        set_verbosity(1)

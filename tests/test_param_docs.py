@@ -1,0 +1,13 @@
+"""docs/Parameters.md stays in sync with config.py (the reference keeps
+doc/code sync via a generator, helpers/parameter_generator.py:1-9)."""
+import subprocess
+import sys
+import os
+
+
+def test_param_docs_in_sync():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_param_docs.py"),
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
