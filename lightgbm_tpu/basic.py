@@ -14,6 +14,8 @@ import copy
 import json
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import time
+
 import numpy as np
 
 from .config import Config
@@ -392,9 +394,7 @@ class Booster:
                fobj=None) -> bool:
         """One boosting iteration (reference basic.py:1846). Returns True if
         training finished (cannot split any more)."""
-        import time as _time
-        from .utils.log import debug as _log_debug
-        _t0 = _time.perf_counter()
+        _t0 = time.perf_counter()
         if fobj is not None:
             # custom gradients bypass the aligned engine's score lane:
             # sync the lazily-stale train scores and leave aligned mode
@@ -411,17 +411,20 @@ class Booster:
             hess = np.asarray(hess, np.float32).reshape(k, -1)
             self._model_gen += 1
             out = self._gbdt.train_one_iter(grad, hess)
-            _log_debug("%.3fs elapsed, finished iteration %d"
-                       % (_time.perf_counter() - _t0,
-                          self._gbdt.num_iterations_trained))
+            self._log_iter_time(_t0)
             return out
         self._model_gen += 1
         out = self._gbdt.train_one_iter()
-        # reference logs per-iteration wall time (gbdt.cpp:285-288)
-        _log_debug("%.3fs elapsed, finished iteration %d"
-                   % (_time.perf_counter() - _t0,
-                      self._gbdt.num_iterations_trained))
+        self._log_iter_time(_t0)
         return out
+
+    def _log_iter_time(self, t0: float) -> None:
+        # reference logs per-iteration wall time (gbdt.cpp:285-288)
+        from .utils import log as _log
+        if _log._level >= _log.DEBUG:
+            _log.debug("%.3fs elapsed, finished iteration %d"
+                       % (time.perf_counter() - t0,
+                          self._gbdt.num_iterations_trained))
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()

@@ -645,8 +645,9 @@ class AlignedEngine:
                    feature_mask: Optional[np.ndarray] = None,
                    grads=None):
         """One boosting iteration: gradients + tree build + score-lane
-        update. Returns ((spec, ncommit) | None, exact). `grads` =
-        (g_rows, h_rows) device arrays for non-pointwise objectives."""
+        update. Returns (spec, ncommit_dev, exact_dev) — ALL device
+        values, no sync. `grads` = (g_rows, h_rows) device arrays for
+        non-pointwise objectives."""
         fmask = self.learner._fmask_arr(feature_mask)
         if grads is not None:
             fn = self._program(
@@ -662,16 +663,15 @@ class AlignedEngine:
                 self.rec, self.cnts, fmask, jnp.float32(scale))
         # the records were donated: the physical layout advances either
         # way (harmless — the next root re-reads everything); the SCORE
-        # lane was updated on device only when the replay was exact. The
-        # sole per-iteration sync is this one boolean pull.
+        # lane was updated on device only when the replay was exact.
+        # NOTHING is pulled here: the caller checks `exact_dev` one
+        # iteration later, hiding the host round-trip behind device
+        # compute (an inexact program is a deterministic score-no-op, so
+        # a speculatively-dispatched successor is safely discardable).
         self.rec, self.cnts = rec, cnts
         self._iter_tag += 1
         self._score_cache = None
-        exact = bool(exact_dev)
-        if not exact:
-            self.fallbacks = getattr(self, "fallbacks", 0) + 1
-            return None, False
-        return (spec, ncommit_dev), True
+        return spec, ncommit_dev, exact_dev
 
     def set_row_scores(self, row_scores):
         """Re-ingest ROW-order scores into the score lane (leaf-wise

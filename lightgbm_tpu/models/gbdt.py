@@ -421,7 +421,14 @@ class GBDT:
     def _train_one_iter_aligned(self, init_scores) -> bool:
         """One boosting iteration on the aligned engine. The engine owns
         the training scores (a record lane, permuted); train_score is
-        synced lazily via _sync_train_score()."""
+        synced lazily via _sync_train_score().
+
+        PIPELINED: the exactness flag of iteration i-1 is pulled AFTER
+        dispatching iteration i, hiding the host round-trip (~120 ms on
+        the tunneled runtime) behind device compute. This is safe
+        because an inexact program leaves the score lane untouched, so
+        the speculatively-dispatched successor deterministically
+        rebuilds the same tree and is discarded along with it."""
         cfg = self.cfg
         eng = getattr(self, "_aligned_eng_ref", None)
         if eng is None:
@@ -430,6 +437,46 @@ class GBDT:
                 init_row_scores=np.asarray(self.train_score.score[0]))
             self._aligned_eng_ref = eng
         fmask = self.learner.feature_mask()
+        out = self._dispatch_aligned(eng, fmask)
+        # resolve the PREVIOUS iteration while this one runs on device
+        redo = self._resolve_aligned_pending(final=False)
+        if redo is not None:
+            # previous tree was inexact: the current dispatch rebuilt the
+            # same (failed) tree on unchanged scores — discard it, grow
+            # the failed tree exactly, then dispatch this iteration fresh
+            eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+            stop = self._aligned_fallback_iter(redo[1], eng, redo[2])
+            if stop:
+                return True
+            out = self._dispatch_aligned(eng, fmask)
+        spec, ncommit_dev, exact_dev = out
+        self._train_score_stale = True
+        lazy = LazyAlignedTree(spec, self.shrinkage_rate, init_scores[0],
+                               self.learner, max(cfg.num_leaves - 1, 1))
+        self.models.append(lazy)
+        self._pending_numsplits.append(ncommit_dev)
+        self.iter += 1
+        self._aligned_pending = (exact_dev, list(init_scores),
+                                 fmask if fmask is None else fmask.copy())
+        if self.valid_scores:
+            # valid-set scores need the committed tree NOW: resolve this
+            # iteration synchronously and apply it
+            res = self._resolve_aligned_pending(final=True)
+            if res is not None:
+                # the exact fallback replaced the speculative tree and
+                # already applied it to the valid scores
+                return bool(res[1])
+            from .aligned_builder import replay_spec
+            rec = replay_spec(jax.device_get(spec), cfg.num_leaves)[0]
+            self._apply_record_to_valid_scores(rec)
+        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
+            res = self._resolve_aligned_pending(final=True)
+            if res is not None and res[1]:
+                return True
+            return self._trim_trailing_empty()
+        return False
+
+    def _dispatch_aligned(self, eng, fmask):
         grads = None
         if eng._pgrad is None:
             # non-pointwise objective (ranking): gradients need ROW order
@@ -437,32 +484,36 @@ class GBDT:
             scores = eng.row_scores_dev()
             gd, hd = self.objective.get_gradients(scores[None, :])
             grads = (gd[0], hd[0])
-        out, exact = eng.train_iter(self.shrinkage_rate, fmask,
-                                    grads=grads)
-        if not exact:
-            # speculation too shallow for an exact leaf-wise replay:
-            # grow this tree with the sequential leaf-wise builder and
-            # push the row scores back into the engine (rare with the
-            # need-driven speculation policy)
-            return self._aligned_fallback_iter(init_scores, eng, fmask)
-        spec, ncommit_dev = out
-        self._train_score_stale = True
-        lazy = LazyAlignedTree(spec, self.shrinkage_rate, init_scores[0],
-                               self.learner, max(cfg.num_leaves - 1, 1))
-        self.models.append(lazy)
-        if self.valid_scores:
-            # valid-set scores need the committed tree NOW (sync pull +
-            # host replay); the no-valid-set path stays fully async
-            from .aligned_builder import replay_spec
-            rec = replay_spec(jax.device_get(spec), cfg.num_leaves)[0]
-            self._apply_record_to_valid_scores(rec)
-        self._pending_numsplits.append(ncommit_dev)
-        self.iter += 1
-        if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
-            return self._trim_trailing_empty()
-        return False
+        return eng.train_iter(self.shrinkage_rate, fmask, grads=grads)
+
+    def _resolve_aligned_pending(self, final: bool):
+        """Pull the pending iteration's exactness flag. Returns:
+        - None: nothing pending, or the tree was exact;
+        - ("redo", init_scores, fmask) when final=False and the tree was
+          inexact (popped; the caller reruns it);
+        - ("fellback", stop) when final=True and the tree was inexact:
+          the exact fallback already replaced it (including valid-score
+          application); `stop` is the fallback's stop signal."""
+        pending = getattr(self, "_aligned_pending", None)
+        if pending is None:
+            return None
+        self._aligned_pending = None
+        exact_dev, init_scores, fmask = pending
+        if bool(exact_dev):
+            return None
+        # discard the speculative tree
+        self.models.pop()
+        self._pending_numsplits.pop()
+        self.iter -= 1
+        if final:
+            eng = self._aligned_eng_ref
+            eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+            stop = self._aligned_fallback_iter(init_scores, eng, fmask)
+            return ("fellback", stop)
+        return ("redo", init_scores, fmask)
 
     def _aligned_fallback_iter(self, init_scores, eng, fmask) -> bool:
+        # (callers guarantee no unresolved pending iteration here)
         """Exact leaf-wise tree for an iteration whose speculative build
         could not be replayed exactly (the aligned analogue of the level
         builder's fallback)."""
@@ -487,6 +538,7 @@ class GBDT:
     def _sync_train_score(self) -> None:
         """Materialize row-order training scores from the aligned engine
         (lazy: only metrics / renewal / rollback need them)."""
+        self._resolve_aligned_pending(final=True)
         if getattr(self, "_train_score_stale", False):
             eng = getattr(self, "_aligned_eng_ref", None)
             if eng is not None:
@@ -497,6 +549,7 @@ class GBDT:
     def _drop_aligned(self) -> None:
         """Leave aligned mode permanently (rollback and other mutations
         the permuted engine state cannot follow)."""
+        self._resolve_aligned_pending(final=True)
         self._sync_train_score()
         self._aligned_disabled = True
         self._aligned_eng_ref = None
@@ -636,6 +689,8 @@ class GBDT:
     def materialized_models(self) -> List[Tree]:
         """Convert any LazyTree records to host Trees in ONE batched
         device->host transfer."""
+        if getattr(self, "_aligned_pending", None) is not None:
+            self._resolve_aligned_pending(final=True)
         lazies = [(i, m) for i, m in enumerate(self.models)
                   if isinstance(m, LazyTree)]
         if lazies:
