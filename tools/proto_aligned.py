@@ -19,6 +19,11 @@ slicing is needed anywhere (Mosaic requires 128-aligned lane slices).
    f32.
 
 Run on the real chip: python tools/proto_aligned.py [n_rows]
+
+SUPERSEDED for production use by `lightgbm_tpu/ops/aligned.py` (which
+fuses move+hist, adds the copy fast-path, deferred DMA waits and full
+routing semantics); kept as the self-contained measurement harness the
+production kernels were derived from.
 """
 import functools
 import sys
