@@ -492,6 +492,17 @@ class Config:
 
     # ------------------------------------------------------------------
     @property
+    def forces_host_learner(self) -> bool:
+        """True when config alone routes training to the host
+        SerialTreeLearner (forced splits / CEGB are implemented there,
+        serial_learner.py). GBDT.use_fused and Dataset._maybe_bundle
+        must agree on this, so it lives in one place."""
+        return bool(self.forcedsplits_filename) \
+            or self.cegb_penalty_split > 0 \
+            or len(self.cegb_penalty_feature_coupled) > 0 \
+            or len(self.cegb_penalty_feature_lazy) > 0
+
+    @property
     def num_tree_per_iteration(self) -> int:
         if self.objective == "multiclass" or self.objective == "multiclassova":
             return self.num_class

@@ -415,7 +415,10 @@ class Dataset:
                 or self.bins.dtype != np.uint8 or self.num_features < 3
                 or cfg.tree_learner != "serial"
                 or str(cfg.boosting) not in ("gbdt", "goss")
-                or str(cfg.objective) in renew):
+                or str(cfg.objective) in renew
+                # the host SerialTreeLearner reads per-FEATURE bins — its
+                # split/histogram code has no bundled view
+                or cfg.forces_host_learner):
             return
         used = self.real_feature_idx
         nb = np.asarray([self.mappers[j].num_bin for j in used], np.int32)

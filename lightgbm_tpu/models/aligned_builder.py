@@ -101,14 +101,12 @@ class AlignedEngine:
         self.objective = objective
         self.cfg = learner.cfg
         self.interpret = interpret
-        C = int(getattr(self.cfg, "tpu_chunk", 0))
-        if C <= 0:
-            # 512 measured best on v5e at 10.5M rows: 256 halves the
-            # permutation matmul but doubles grid/DMA/glue fixed costs
-            # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
-            # NC at 65k chunks
-            C = 512
-        self.C = C
+        # 512 measured best on v5e at 10.5M rows: 256 halves the
+        # permutation matmul but doubles grid/DMA/glue fixed costs
+        # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
+        # NC at 65k chunks
+        from ..ops.aligned import effective_chunk
+        self.C = C = effective_chunk(self.cfg)
         bins = np.asarray(learner.ds.bins)
         if learner.num_features != learner.num_real_features:
             pad = learner.num_features - learner.num_real_features
@@ -140,6 +138,10 @@ class AlignedEngine:
         self._programs = {}
         self._score_cache = None     # (iter_tag, np array)
         self._iter_tag = 0
+        # exactness of the LAST dispatched program (device scalar): the
+        # next dispatch gates its score update on it, so a successor of
+        # an inexact tree is a guaranteed score no-op (see build())
+        self._last_exact = jnp.asarray(True)
 
     # ------------------------------------------------------------------
     def row_scores_dev(self):
@@ -314,7 +316,7 @@ class AlignedEngine:
 
         eval_all = jax.vmap(eval_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0))
 
-        def build(rec, cnts_pc, feature_mask_f32, scale_in,
+        def build(rec, cnts_pc, feature_mask_f32, scale_in, prev_ok,
                   g_rows=None, h_rows=None):
             if external_grads:
                 rid = jnp.clip(rec[:, ln["rid"], :], 0, self.n - 1)
@@ -615,11 +617,15 @@ class AlignedEngine:
                                   jnp.zeros(S + 1, jnp.float32))
 
             # ---- score-lane update ON DEVICE (only when the replay is
-            # exact; the caller falls back to the sequential leaf-wise
-            # builder otherwise and re-ingests row scores)
+            # exact AND the previous dispatch committed: a program
+            # dispatched speculatively after an inexact predecessor will
+            # be discarded by the host, so prev_ok forces it to be a
+            # score no-op instead of trusting it to rebuild identically
+            # on the shifted physical layout)
             exists_f = jnp.arange(S + 1) <= n_exec
             slot_f, _, _, _, in_any_f = chunk_maps(leafI, exists_f)
-            valmap = jnp.where(in_any_f & exact, cover[slot_f], 0.0)
+            valmap = jnp.where(in_any_f & exact & prev_ok,
+                               cover[slot_f], 0.0)
             sc = _f32(rec[:, ln["score"], :]) + valmap[:, None] * scale_in
             rec = rec.at[:, ln["score"], :].set(_i32(sc))
 
@@ -656,11 +662,13 @@ class AlignedEngine:
                 donate=(0,))
             rec, cnts, spec, exact_dev, ncommit_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
-                grads[0], grads[1])
+                self._last_exact, grads[0], grads[1])
         else:
             fn = self._program("build", self._build_program, donate=(0,))
             rec, cnts, spec, exact_dev, ncommit_dev = fn(
-                self.rec, self.cnts, fmask, jnp.float32(scale))
+                self.rec, self.cnts, fmask, jnp.float32(scale),
+                self._last_exact)
+        self._last_exact = exact_dev
         # the records were donated: the physical layout advances either
         # way (harmless — the next root re-reads everything); the SCORE
         # lane was updated on device only when the replay was exact.
@@ -679,6 +687,7 @@ class AlignedEngine:
         fn = self._program("setsc", self._set_scores_program, donate=(0,))
         self.rec = fn(self.rec, jnp.asarray(row_scores, jnp.float32))
         self._score_cache = None
+        self._last_exact = jnp.asarray(True)   # lane is authoritative again
 
     def _set_scores_program(self):
         ln = self.lanes

@@ -848,12 +848,20 @@ class DeviceTreeLearner:
         from ..ops.aligned import aligned_available
         if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
             return False
+        from ..ops.aligned import aligned_num_chunks
+        from .level_builder import spec_slots
+        S = spec_slots(self.cfg.num_leaves,
+                       float(getattr(self.cfg, "tpu_level_spec", 1.5)))
+        nc = aligned_num_chunks(self.n, self.cfg, S)
         return (self.parallel_mode == "serial"
                 and not self.bundled
                 # packed-prefetch limits: 16-bit destination chunk ids
-                # (NC <= 65535 at chunk 512) and 8-bit word selectors
-                # (features <= 1020)
-                and self.n <= 512 * 65000
+                # (NC <= 65535 at the EFFECTIVE chunk size) and 8-bit
+                # word selectors (features <= 1020); n capped at 2^24
+                # because the layout trusts BI_LC — an f32 sum of
+                # histogram count stats, exact only below 2^24
+                and nc <= 65535
+                and self.n <= (1 << 24)
                 and self.num_features <= 1020
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8

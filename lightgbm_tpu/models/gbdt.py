@@ -144,10 +144,7 @@ class GBDT:
             and not (self.objective is not None
                      and getattr(self.objective, "is_renew_tree_output",
                                  False))
-            and not cfg.forcedsplits_filename
-            and not (cfg.cegb_penalty_split > 0
-                     or len(cfg.cegb_penalty_feature_coupled) > 0
-                     or len(cfg.cegb_penalty_feature_lazy) > 0)
+            and not cfg.forces_host_learner
             and cfg.tree_learner in ("serial", "data", "feature", "voting"))
         if self.use_fused:
             if cfg.tree_learner == "serial" or len(jax.devices()) == 1:
