@@ -62,6 +62,12 @@ class AlignedSpec(NamedTuple):
     bestB: jax.Array       # u32[S, 8]
     leafF: jax.Array       # f32[S, LF_W]
     leafI: jax.Array       # i32[S, LI_W]  (LI_BEGIN in CHUNK units)
+    # committed-tree view for the DEVICE valid-set walker (gbdt.cpp:
+    # 487-506 without the host replay): first committed exec per slot,
+    # next committed exec per exec, committed leaf value per slot
+    first_c: jax.Array     # i32[S+1]
+    nxt_c: jax.Array       # i32[Sm1+1]
+    cover: jax.Array       # f32[S+1]
 
 
 def _f32(x):
@@ -229,13 +235,14 @@ class AlignedEngine:
             active0 = jnp.zeros(S + 1, bool).at[0].set(True)
             ptr0 = jnp.full(S + 1, E_INF, jnp.int32).at[0].set(first_e[0])
             st0 = (active0, ptr0, jnp.zeros(Sm1 + 1, bool),
-                   jnp.zeros(S + 1, bool), jnp.int32(0), jnp.bool_(False))
+                   jnp.zeros(S + 1, bool), jnp.int32(0), jnp.int32(0),
+                   jnp.bool_(False))
 
             def rcond(st):
-                return (~st[5]) & (st[4] < Lm1_commit)
+                return (~st[6]) & (st[4] < Lm1_commit)
 
             def rbody(st):
-                active, ptr, commit, need, ncommit, _ = st
+                active, ptr, commit, need, ncommit, nneed, _ = st
                 has_e = ptr < E_INF
                 pe = jnp.clip(ptr, 0, Sm1)
                 g = jnp.where(has_e, execF[pe, SF_GAIN], best_gain)
@@ -246,10 +253,20 @@ class AlignedEngine:
                 he = has_e[sl]
                 e = pe[sl]
                 take = (~stop) & he
-                front = (~stop) & ~he
+                # BUDGET-CAPPED need marking: a frontier pop can only be
+                # in the true tree if, even with every earlier-marked
+                # frontier committing, the L-1 split budget is not yet
+                # spent. Marks beyond that bound are provably outside the
+                # final tree — suppressing them prunes wasted speculative
+                # splits (execs that never commit) without touching
+                # exactness: for an exact tree nneed stays 0 and the cap
+                # reduces to the rcond bound.
+                front = ((~stop) & ~he
+                         & (ncommit + nneed < Lm1_commit))
                 commit = commit.at[e].set(jnp.where(take, True, commit[e]))
                 ncommit = ncommit + take.astype(jnp.int32)
                 need = need.at[sl].set(jnp.where(front, True, need[sl]))
+                nneed = nneed + front.astype(jnp.int32)
                 # left path: slot keeps its chain; frontier pop kills it
                 active = active.at[sl].set(
                     jnp.where(stop, active[sl], he))
@@ -258,9 +275,9 @@ class AlignedEngine:
                 active = active.at[r].set(
                     jnp.where(take, True, active[r]))
                 ptr = ptr.at[r].set(jnp.where(take, first_e[r], ptr[r]))
-                return (active, ptr, commit, need, ncommit, stop)
+                return (active, ptr, commit, need, ncommit, nneed, stop)
 
-            _, _, commit, need, ncommit, _ = lax.while_loop(
+            _, _, commit, need, ncommit, _, _ = lax.while_loop(
                 rcond, rbody, st0)
             return commit, need, ncommit
 
@@ -567,11 +584,15 @@ class AlignedEngine:
                 bestI = jnp.where(exists2[:, None], bI, bestI)
                 bestB = jnp.where(exists2[:, None], bB, bestB)
 
-                # While fewer than L-1 splits exist, the replay would pop
-                # EVERY candidate before exhausting its commit budget, so
-                # need = all positive tips without running it (the real
-                # replay always runs before the loop can exit: the exit
-                # needs an empty need, impossible in this branch).
+                # Replay-skip shortcut, at the PROVABLY equivalent
+                # threshold: with e = done + k execs, the capped replay
+                # pops at most e commits + (e + 1) frontier tips, so
+                # while 2e + 1 < L-1 the budget cap cannot bind and
+                # need == every positive slot — no replay required. (The
+                # old done+k < L-1 threshold over-asked by up to ~L/2
+                # execs in the transition rounds; past the new threshold
+                # the real budget-capped replay prunes the frontier to
+                # what the true leaf-wise order can still reach.)
                 def full_replay(_):
                     return device_replay(execF, execI, bestF[:, BF_GAIN],
                                          done + k)
@@ -581,8 +602,8 @@ class AlignedEngine:
                     return (jnp.zeros(Sm1 + 1, bool), nd, jnp.int32(0))
 
                 commit, need2, ncommit = lax.cond(
-                    done + k < Lm1_commit, all_needed, full_replay,
-                    operand=None)
+                    2 * (done + k) + 1 < Lm1_commit, all_needed,
+                    full_replay, operand=None)
 
                 return (done + k, rec, cnts_pc, leafF, leafI, bestF, bestI,
                         bestB, hist_store, execF, execI, execB, need2,
@@ -616,6 +637,25 @@ class AlignedEngine:
             cover = lax.fori_loop(0, Sm1, cov_step,
                                   jnp.zeros(S + 1, jnp.float32))
 
+            # ---- committed-only chains (valid-set device walker): the
+            # committed tree's topology as slot-chain pointers, same
+            # grouping trick as device_replay but filtered to commits
+            eidx_c = jnp.arange(Sm1 + 1, dtype=jnp.int32)
+            slot_ec = execI[:, SI_SLOT]
+            valid_c = (eidx_c < n_exec) & commit
+            first_c = jnp.full(S + 1, E_INF, jnp.int32).at[
+                jnp.where(valid_c, slot_ec, S)].min(
+                jnp.where(valid_c, eidx_c, E_INF))
+            key_c = jnp.where(valid_c, slot_ec, S + 2) * (Sm1 + 2) + eidx_c
+            order_c = jnp.argsort(key_c)
+            so_c = slot_ec[order_c]
+            same_c = jnp.concatenate(
+                [(so_c[:-1] == so_c[1:]) & valid_c[order_c[1:]],
+                 jnp.zeros(1, bool)])
+            nxt_c = jnp.full(Sm1 + 1, E_INF, jnp.int32).at[order_c].set(
+                jnp.where(same_c, jnp.concatenate(
+                    [order_c[1:], jnp.full(1, E_INF, jnp.int32)]), E_INF))
+
             # ---- score-lane update ON DEVICE (only when the replay is
             # exact AND the previous dispatch committed: a program
             # dispatched speculatively after an inexact predecessor will
@@ -624,8 +664,8 @@ class AlignedEngine:
             # on the shifted physical layout)
             exists_f = jnp.arange(S + 1) <= n_exec
             slot_f, _, _, _, in_any_f = chunk_maps(leafI, exists_f)
-            valmap = jnp.where(in_any_f & exact & prev_ok,
-                               cover[slot_f], 0.0)
+            applied = exact & prev_ok
+            valmap = jnp.where(in_any_f & applied, cover[slot_f], 0.0)
             sc = _f32(rec[:, ln["score"], :]) + valmap[:, None] * scale_in
             rec = rec.at[:, ln["score"], :].set(_i32(sc))
 
@@ -634,8 +674,9 @@ class AlignedEngine:
                                execI=execI[:Sm1], execB=execB[:Sm1],
                                bestF=bestF[:S], bestI=bestI[:S],
                                bestB=bestB[:S], leafF=leafF[:S],
-                               leafI=leafI[:S])
-            return rec, cnts_pc, spec, exact, ncommit
+                               leafI=leafI[:S], first_c=first_c,
+                               nxt_c=nxt_c, cover=cover)
+            return rec, cnts_pc, spec, exact, ncommit, applied
 
         return build
 
@@ -651,21 +692,24 @@ class AlignedEngine:
                    feature_mask: Optional[np.ndarray] = None,
                    grads=None):
         """One boosting iteration: gradients + tree build + score-lane
-        update. Returns (spec, ncommit_dev, exact_dev) — ALL device
-        values, no sync. `grads` = (g_rows, h_rows) device arrays for
-        non-pointwise objectives."""
+        update. Returns (spec, ncommit_dev, exact_dev, applied_dev) —
+        ALL device values, no sync. `applied_dev` = exact & prev_ok: True
+        iff this program's score-lane update actually happened (a
+        dispatch following an inexact predecessor is a guaranteed no-op
+        and will be discarded by the host). `grads` = (g_rows, h_rows)
+        device arrays for non-pointwise objectives."""
         fmask = self.learner._fmask_arr(feature_mask)
         if grads is not None:
             fn = self._program(
                 "build_ext",
                 lambda: self._build_program(external_grads=True),
                 donate=(0,))
-            rec, cnts, spec, exact_dev, ncommit_dev = fn(
+            rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact, grads[0], grads[1])
         else:
             fn = self._program("build", self._build_program, donate=(0,))
-            rec, cnts, spec, exact_dev, ncommit_dev = fn(
+            rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact)
         self._last_exact = exact_dev
@@ -679,7 +723,71 @@ class AlignedEngine:
         self.rec, self.cnts = rec, cnts
         self._iter_tag += 1
         self._score_cache = None
-        return spec, ncommit_dev, exact_dev
+        return spec, ncommit_dev, exact_dev, applied_dev
+
+    def apply_spec_to_scores(self, score, vbins, spec, applied, scale):
+        """score [Nv] += scale * committed_tree(vbins) ON DEVICE — the
+        valid-set analogue of the score-lane update (gbdt.cpp:487-506),
+        walking the committed-exec chains of the spec. Gated by `applied`
+        (the exact & prev_ok flag): a dispatch the host will discard
+        contributes exactly 0, so this can be dispatched pipelined with
+        no sync."""
+        key = ("walk", vbins.shape)
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = jax.jit(self._walk_program(), donate_argnums=(0,))
+            self._programs[key] = fn
+        return fn(score, vbins, spec.execI, spec.execB, spec.first_c,
+                  spec.nxt_c, spec.cover, jnp.float32(scale), applied)
+
+    def _walk_program(self):
+        lr = self.learner
+        S, Sm1 = self.S, self.S - 1
+        E_INF = Sm1 + 1
+        nb = jnp.asarray(lr.meta["num_bin"], jnp.int32)
+        db = jnp.asarray(lr.meta["default_bin"], jnp.int32)
+        mt = jnp.asarray(lr.meta["missing_type"], jnp.int32)
+
+        def fn(score, vb, execI, execB, first_c, nxt_c, cover, scale,
+               applied):
+            nv = vb.shape[0]
+            node0 = jnp.full(nv, first_c[0], jnp.int32)
+            slot0 = jnp.zeros(nv, jnp.int32)
+
+            def cond(st):
+                return jnp.any(st[0] < E_INF)
+
+            def body(st):
+                node, slot = st
+                act = node < E_INF
+                e = jnp.clip(node, 0, Sm1)
+                f = execI[e, SI_FEAT]
+                binv = jnp.take_along_axis(
+                    vb, jnp.clip(f, 0, vb.shape[1] - 1)[:, None],
+                    axis=1)[:, 0].astype(jnp.int32)
+                thr = execI[e, SI_THR]
+                dl = execI[e, SI_DEFLEFT] != 0
+                iscat = execI[e, SI_ISCAT] != 0
+                mtf = mt[f]
+                is_def = ((mtf == 1) & (binv == db[f])) | \
+                         ((mtf == 2) & (binv == nb[f] - 1))
+                num_left = jnp.where(is_def, dl, binv <= thr)
+                w = jnp.take_along_axis(
+                    execB[e].astype(jnp.uint32),
+                    jnp.clip(binv >> 5, 0, 7)[:, None], axis=1)[:, 0]
+                cat_left = (((w >> (binv & 31).astype(jnp.uint32)) & 1)
+                            != 0)
+                left = jnp.where(iscat, cat_left, num_left)
+                nn = jnp.where(left, nxt_c[e],
+                               first_c[jnp.clip(e + 1, 0, S)])
+                ns = jnp.where(left, slot, jnp.clip(e + 1, 0, S))
+                return (jnp.where(act, nn, node),
+                        jnp.where(act, ns, slot))
+
+            node, slot = lax.while_loop(cond, body, (node0, slot0))
+            gate = applied.astype(jnp.float32)
+            return score + cover[jnp.clip(slot, 0, S)] * scale * gate
+        return fn
 
     def set_row_scores(self, row_scores):
         """Re-ingest ROW-order scores into the score lane (leaf-wise

@@ -73,6 +73,17 @@ class LazyAlignedTree(LazyTree):
         return tree
 
 
+class _DeviceScoreView:
+    """Duck-typed stand-in for _ScoreUpdater in _eval: a device [K, N]
+    score matrix materialized on demand."""
+
+    def __init__(self, score) -> None:
+        self.score = score
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.score, np.float64)
+
+
 class _ScoreUpdater:
     """Per-dataset cached raw scores (reference ScoreUpdater,
     score_updater.hpp:27-85)."""
@@ -446,7 +457,7 @@ class GBDT:
             if stop:
                 return True
             out = self._dispatch_aligned(eng, fmask)
-        spec, ncommit_dev, exact_dev = out
+        spec, ncommit_dev, exact_dev, applied_dev = out
         self._train_score_stale = True
         lazy = LazyAlignedTree(spec, self.shrinkage_rate, init_scores[0],
                                self.learner, max(cfg.num_leaves - 1, 1))
@@ -455,17 +466,15 @@ class GBDT:
         self.iter += 1
         self._aligned_pending = (exact_dev, list(init_scores),
                                  fmask if fmask is None else fmask.copy())
-        if self.valid_scores:
-            # valid-set scores need the committed tree NOW: resolve this
-            # iteration synchronously and apply it
-            res = self._resolve_aligned_pending(final=True)
-            if res is not None:
-                # the exact fallback replaced the speculative tree and
-                # already applied it to the valid scores
-                return bool(res[1])
-            from .aligned_builder import replay_spec
-            rec = replay_spec(jax.device_get(spec), cfg.num_leaves)[0]
-            self._apply_record_to_valid_scores(rec)
+        # valid-set scores: walk the committed tree ON DEVICE from the
+        # spec, still pipelined — the walk is gated by the program's own
+        # applied flag, so a dispatch the host later discards (inexact
+        # predecessor / fallback) contributed exactly 0 and the exact
+        # fallback's host application stays correct
+        for i, su in enumerate(self.valid_scores):
+            su.score = su.score.at[0].set(eng.apply_spec_to_scores(
+                su.score[0], self._valid_bins_dev[i], spec, applied_dev,
+                self.shrinkage_rate))
         if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
             res = self._resolve_aligned_pending(final=True)
             if res is not None and res[1]:
@@ -739,25 +748,46 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        # aligned engine: evaluate from a DEVICE score view when every
+        # metric supports it — the permuted->row materialization stays on
+        # device instead of bouncing [N] f32 through the host
+        eng = getattr(self, "_aligned_eng_ref", None)
+        if (eng is not None and self.train_metrics
+                and all(type(m).eval_dev is not Metric.eval_dev
+                        for m in self.train_metrics)):
+            self._resolve_aligned_pending(final=True)
+            if getattr(self, "_train_score_stale", False):
+                view = _DeviceScoreView(eng.row_scores_dev()[None, :])
+                return self._eval(view, self.train_metrics, "training")
         self._sync_train_score()
         return self._eval(self.train_score, self.train_metrics, "training")
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        # an inexact pending aligned iteration contributed 0 to the valid
+        # scores (applied gate): resolve it NOW so the exact fallback tree
+        # is applied before its metrics are recorded
+        self._resolve_aligned_pending(final=True)
         out = []
         for i, (su, ms) in enumerate(zip(self.valid_scores,
                                          self.valid_metrics)):
             out.extend(self._eval(su, ms, f"valid_{i}"))
         return out
 
-    def _eval(self, su: _ScoreUpdater, metrics: List[Metric],
+    def _eval(self, su, metrics: List[Metric],
               name: str) -> List[Tuple[str, str, float, bool]]:
         if not metrics:
             return []
-        scores = su.numpy()
+        # dispatch all device-capable metrics first (async), then emit in
+        # the USER'S metric order — first_metric_only early stopping keys
+        # on position 0 of the result list
+        dev_vals = [m.eval_dev(su.score, self.objective) for m in metrics]
+        scores = su.numpy() if any(d is None for d in dev_vals) else None
         out = []
-        for m in metrics:
-            for mname, val in m.eval(scores, self.objective):
-                out.append((name, mname, val, m.bigger_is_better))
+        for m, dev in zip(metrics, dev_vals):
+            pairs = (dev if dev is not None
+                     else m.eval(scores, self.objective))
+            for mname, val in pairs:
+                out.append((name, mname, float(val), m.bigger_is_better))
         return out
 
     # ------------------------------------------------------------------
@@ -767,16 +797,18 @@ class GBDT:
 
     def predict_raw(self, X: np.ndarray,
                     num_iteration: Optional[int] = None) -> np.ndarray:
-        """Raw scores for a dense matrix [N, F_total] -> [N, K]."""
+        """Raw scores for a dense matrix [N, F_total] -> [N, K]
+        (vectorized batch traversal, predictor.hpp:66-115 semantics)."""
+        from ..ops.predict import predict_raw_values
         self.materialized_models()
         trees = self._trees_for(num_iteration)
         n = len(X)
         k = self.num_tree_per_iteration
         out = np.zeros((n, k), np.float64)
-        for i, tree in enumerate(trees):
-            cls = i % k
-            for r in range(n):
-                out[r, cls] += tree.predict_row(X[r])
+        for cls in range(k):
+            cls_trees = trees[cls::k]
+            if cls_trees:
+                out[:, cls] = predict_raw_values(cls_trees, X)
         return out
 
     def _trees_for(self, num_iteration: Optional[int]) -> List[Tree]:

@@ -1,0 +1,116 @@
+"""Device valid-set scoring + device metrics (round 4, VERDICT #2).
+
+The aligned path now walks valid rows down the committed tree ON DEVICE
+from the spec's committed-exec chains — no host replay, no sync. These
+tests run the aligned builder in interpret mode on CPU and compare the
+device-walked valid scores/metrics against the host traversal path.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+
+def _make(n=3000, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]
+          + 0.3 * rng.standard_normal(n)) > 0).astype(np.float32)
+    return X, y
+
+
+def _train_with_valid(mode, iters=6):
+    X, y = _make()
+    Xv, yv = _make(1200, seed=1)
+    params = {"objective": "binary", "num_leaves": 8, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "auc,binary_logloss",
+              "tpu_grow_mode": mode,
+              "tpu_aligned_interpret": mode == "aligned",
+              "tpu_chunk": 256}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    vs = lgb.Dataset(Xv, label=yv, reference=ds, params=params).construct()
+    res = {}
+    bst = lgb.train(params, ds, iters, valid_sets=[vs],
+                    valid_names=["v"], evals_result=res,
+                    verbose_eval=False)
+    return bst, res
+
+
+def test_device_valid_scores_match_host_traversal():
+    bst_a, res_a = _train_with_valid("aligned")
+    bst_l, res_l = _train_with_valid("leafwise")
+    # identical trees => identical valid AUC curves (device walk vs the
+    # leafwise host-side traversal application)
+    auc_a = np.asarray(res_a["v"]["auc"])
+    auc_l = np.asarray(res_l["v"]["auc"])
+    assert np.allclose(auc_a, auc_l, atol=2e-6), (auc_a, auc_l)
+    ll_a = np.asarray(res_a["v"]["binary_logloss"])
+    ll_l = np.asarray(res_l["v"]["binary_logloss"])
+    assert np.allclose(ll_a, ll_l, atol=1e-5), (ll_a, ll_l)
+
+
+def test_device_auc_matches_host_auc():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ops.metrics import AUCMetric
+
+    class Meta:
+        weight = None
+        init_score = None
+
+    rng = np.random.default_rng(3)
+    n = 30000
+    score = np.round(rng.standard_normal(n), 2)  # many ties
+    label = (rng.random(n) < 1 / (1 + np.exp(-score))).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary"})
+    m = AUCMetric(cfg)
+    meta = Meta()
+    meta.label = label
+    m.init(meta, n)
+    scores = score[None, :].astype(np.float64)
+    host = m.eval(scores, None)[0][1]
+    import jax.numpy as jnp
+    dev = float(m.eval_dev(jnp.asarray(scores, jnp.float32), None)[0][1])
+    assert abs(host - dev) < 1e-5, (host, dev)
+
+
+def test_device_auc_weighted():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ops.metrics import AUCMetric
+
+    class Meta:
+        init_score = None
+
+    rng = np.random.default_rng(5)
+    n = 20000
+    score = np.round(rng.standard_normal(n), 2)
+    label = (rng.random(n) < 0.4).astype(np.float64)
+    w = rng.random(n).astype(np.float64) + 0.1
+    cfg = Config.from_params({"objective": "binary"})
+    m = AUCMetric(cfg)
+    meta = Meta()
+    meta.label = label
+    meta.weight = w
+    m.init(meta, n)
+    scores = score[None, :].astype(np.float64)
+    host = m.eval(scores, None)[0][1]
+    import jax.numpy as jnp
+    dev = float(m.eval_dev(jnp.asarray(scores, jnp.float32), None)[0][1])
+    assert abs(host - dev) < 5e-5, (host, dev)
+
+
+def test_valid_with_early_stopping_aligned():
+    X, y = _make(4000)
+    Xv, yv = _make(1500, seed=2)
+    params = {"objective": "binary", "num_leaves": 8, "max_bin": 63,
+              "learning_rate": 0.3, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "auc",
+              "tpu_grow_mode": "aligned", "tpu_aligned_interpret": True,
+              "tpu_chunk": 256}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    vs = lgb.Dataset(Xv, label=yv, reference=ds, params=params).construct()
+    bst = lgb.train(params, ds, 40, valid_sets=[vs], valid_names=["v"],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration >= 1

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Honest DEVICE-time kernel measurement: chain k executions inside one
+jitted program (fori_loop), time via device_get deltas between k=1 and
+k=K. Removes host dispatch / tunnel overhead from the numbers.
+
+python tools/device_time_r4.py [n] [max_bin] [C ...]
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
+CS = [int(c) for c in sys.argv[3:]] or [512, 1024, 2048]
+F = 28
+S = 64
+K = 8
+
+
+def dget(x):
+    return np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(x)[0].reshape(-1)[:1]))
+
+
+def dev_time(mk_fn, *args):
+    """mk_fn(k) -> jitted fn running the kernel k times. Returns (per-exec
+    seconds, total-k time)."""
+    f1, fK = mk_fn(1), mk_fn(K)
+    for f in (f1, fK):          # compile + warm
+        dget(f(*args))
+    reps = 3
+    ts = []
+    for f in (f1, fK):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        dget(out)
+        ts.append((time.perf_counter() - t0) / reps)
+    return (ts[1] - ts[0]) / (K - 1), ts
+
+
+def main():
+    from lightgbm_tpu.ops.aligned import move_pass, pack_records, \
+        slot_hist_pass
+
+    rng = np.random.RandomState(3)
+    bins = rng.randint(0, MB, (N, F)).astype(np.uint8)
+    label = rng.randint(0, 2, N).astype(np.float32)
+    group = 8 if MB <= 64 else 4
+    B = MB + 1 if MB % 2 else MB
+
+    for C in CS:
+        rec_np, wcnt, W, cnts = pack_records(bins, label, None, C)
+        nc_data = rec_np.shape[0]
+        NC = nc_data + 4
+        fullr = np.zeros((NC, W, C), np.int32)
+        fullr[:nc_data] = rec_np
+        rec = jnp.asarray(fullr)
+        del fullr
+        meta_cnt = np.zeros(NC, np.int32)
+        meta_cnt[:nc_data] = cnts
+        iota = np.arange(NC, dtype=np.int32)
+        r2 = np.zeros(NC, np.int32) | (B << 16)
+        wsel = np.zeros(NC, np.int32)
+        nohist = np.full(NC, S + 1, np.int32)
+
+        # ---- split-everything (block = whole data, no hist)
+        r1 = np.full(NC, (MB // 2) | (1 << 13), np.int32)
+        meta = meta_cnt.copy()
+        meta[0] |= 1 << 20
+        meta[nc_data - 1] |= 1 << 21
+        basel = np.zeros(NC, np.int32)
+        baser = np.full(NC, nc_data // 2, np.int32)
+
+        def mk_move(k, hsl, r1v, metav, blv, brv):
+            a = tuple(jnp.asarray(x) for x in
+                      (r1v, r2, blv, brv, metav, wsel, hsl))
+
+            @jax.jit
+            def f(r):
+                def body(i, r):
+                    r2_, _ = move_pass(r, *a, C, W, wcnt, S + 1, F, B,
+                                       group)
+                    return r2_
+                return lax.fori_loop(0, k, body, r)
+            return f
+
+        try:
+            per, ts = dev_time(functools.partial(
+                mk_move, hsl=nohist, r1v=r1, metav=meta, blv=basel,
+                brv=baser), rec)
+            print(f"C={C}: move_split_nohist dev={per*1e3:.1f}ms "
+                  f"({per/N*1e9:.2f}ns/row) [t1={ts[0]*1e3:.0f} "
+                  f"tK={ts[1]*1e3:.0f}]", flush=True)
+            per, ts = dev_time(functools.partial(
+                mk_move, hsl=np.zeros(NC, np.int32), r1v=r1, metav=meta,
+                blv=basel, brv=baser), rec)
+            print(f"C={C}: move_split_hist  dev={per*1e3:.1f}ms "
+                  f"({per/N*1e9:.2f}ns/row)", flush=True)
+            r1c = np.full(NC, (1 << 16), np.int32)
+            metac = (meta_cnt | (1 << 20) | (1 << 21)).astype(np.int32)
+            per, ts = dev_time(functools.partial(
+                mk_move, hsl=nohist, r1v=r1c, metav=metac, blv=iota,
+                brv=iota), rec)
+            print(f"C={C}: move_all_copy    dev={per*1e3:.1f}ms "
+                  f"({per/N*1e9:.2f}ns/row)", flush=True)
+        except Exception as e:
+            print(f"C={C}: move FAILED {type(e).__name__} {str(e)[:200]}",
+                  flush=True)
+
+        # ---- hist full pass (chained via a tiny record perturbation so
+        # the loop body cannot be hoisted)
+        slots = np.zeros(NC, np.int32)
+        slots[nc_data:] = S + 1
+        sl_j = jnp.asarray(slots)
+        mc_j = jnp.asarray(meta_cnt)
+
+        def mk_hist(k):
+            @jax.jit
+            def f(r):
+                def body(i, carry):
+                    r, acc = carry
+                    h = slot_hist_pass(r, sl_j, mc_j, S + 1, F, B, C,
+                                       group, wcnt)
+                    r = r.at[0, 0, 0].add(1)
+                    return (r, acc + h[0, 0, 0, 0])
+                return lax.fori_loop(0, k, body, (r, jnp.float32(0.0)))
+            return f
+
+        try:
+            per, ts = dev_time(mk_hist, rec)
+            print(f"C={C}: hist_full        dev={per*1e3:.1f}ms "
+                  f"({per/N*1e9:.2f}ns/row)", flush=True)
+        except Exception as e:
+            print(f"C={C}: hist FAILED {type(e).__name__} {str(e)[:200]}",
+                  flush=True)
+        del rec
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Round-4 per-iteration cost decomposition of the aligned pipeline.
+
+Measures, at HIGGS shape (10.5M x 28) on the real chip:
+  1. per-iter wall time + rounds/iter + n_exec/iter over a window
+  2. standalone move_pass at root shape (all chunks split) and all-copy
+  3. standalone slot_hist_pass over the full matrix
+  4. glue-per-iter via a tiny-n run (same S / leaves / round structure)
+
+Usage: python tools/profile_r4.py [n_rows] [max_bin] [iters]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
+ITERS = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+F = 28
+CACHE = f"/tmp/higgs_shape_{N}_{MB}.npz"
+
+
+def gen_data():
+    if os.path.exists(CACHE):
+        z = np.load(CACHE)
+        return z["bins"], z["label"]
+    rng = np.random.RandomState(7)
+    bins = np.empty((N, F), np.uint8)
+    blk = 1 << 20
+    w = rng.rand(F) * 2 - 1
+    label = np.zeros(N, np.float32)
+    acc = np.zeros(N, np.float64)
+    for s in range(0, N, blk):
+        e = min(s + blk, N)
+        x = rng.rand(e - s, F)
+        b = np.minimum((x * MB).astype(np.uint8), MB - 1)
+        bins[s:e] = b
+        acc[s:e] = (x @ w) + rng.randn(e - s) * 0.3
+    label[:] = (acc > np.median(acc)).astype(np.float32)
+    np.savez(CACHE, bins=bins, label=label)
+    return bins, label
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    bins, label = gen_data()
+    print(f"# data ready n={N} mb={MB}", flush=True)
+
+    params = {
+        "objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
+        "max_bin": MB, "min_data_in_leaf": 100, "verbosity": -1,
+    }
+    if os.environ.get("LSPEC"):
+        params["tpu_level_spec"] = float(os.environ["LSPEC"])
+    t0 = time.perf_counter()
+    train_set = lgb.Dataset(bins.astype(np.float32), label=label,
+                            params=params).construct()
+    bst = lgb.Booster(params=params, train_set=train_set)
+    gb = bst._gbdt
+    print(f"# dataset+booster {time.perf_counter()-t0:.1f}s", flush=True)
+    assert gb._aligned_eligible(), "aligned path not eligible!"
+
+    # ---- warmup
+    t0 = time.perf_counter()
+    gb.train_one_iter()
+    print(f"# compile+first iter {time.perf_counter()-t0:.1f}s", flush=True)
+    for _ in range(4):
+        gb.train_one_iter()
+    eng = gb._aligned_eng_ref
+    jax.block_until_ready(eng.rec)
+
+    # ---- per-iter window
+    specs = []
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        gb.train_one_iter()
+        specs.append(gb.models[-1].record)
+    jax.block_until_ready(eng.rec)
+    dt = (time.perf_counter() - t0) / ITERS
+    rounds = [int(jax.device_get(s.rounds)) for s in specs]
+    nexec = [int(jax.device_get(s.n_exec)) for s in specs]
+    print(f"per_iter={dt*1e3:.1f}ms rounds(mean={np.mean(rounds):.1f} "
+          f"min={min(rounds)} max={max(rounds)}) "
+          f"n_exec(mean={np.mean(nexec):.0f} min={min(nexec)} "
+          f"max={max(nexec)})", flush=True)
+    print(f"ms_per_round={dt*1e3/np.mean(rounds):.1f}", flush=True)
+
+    # ---- standalone pass benches on the engine's real state
+    from lightgbm_tpu.ops.aligned import move_pass, slot_hist_pass
+    lr = gb.learner
+    C, W, wcnt = eng.C, eng.W, eng.wcnt
+    NC, S = eng.NC, eng.S
+    B = lr.max_bin_global
+    group = 8 if B <= 64 else 4
+    nc_data = (eng.n + C - 1) // C
+
+    def timeit(fn, reps=8):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    rec = eng.rec
+    meta_cnt = np.full(NC, C, np.int32)
+    meta_cnt[nc_data:] = 0
+    iota = np.arange(NC, dtype=np.int32)
+
+    # root-shape: ONE block spanning data chunks, all split (thr=31)
+    r1 = np.full(NC, 31 | (1 << 13), np.int32)
+    r1[0] |= 0  # first
+    meta = meta_cnt.copy()
+    meta[0] |= 1 << 20
+    meta[nc_data - 1] |= 1 << 21
+    r2 = np.zeros(NC, np.int32) | (MB << 16)
+    basel = np.zeros(NC, np.int32)
+    baser = np.full(NC, nc_data // 2, np.int32)
+    wsel = np.zeros(NC, np.int32)
+    hsl = np.zeros(NC, np.int32)   # accumulate slot 0, left side
+    args = [jnp.asarray(x) for x in (r1, r2, basel, baser, meta, wsel, hsl)]
+    t_move_split = timeit(lambda: move_pass(
+        rec, *args, C, W, wcnt, S + 1, F, B, group))
+    print(f"move_all_split={t_move_split*1e3:.1f}ms "
+          f"({t_move_split/N*1e9:.2f} ns/row)", flush=True)
+
+    # all-copy: every chunk its own copy-through to itself
+    r1c = np.full(NC, (1 << 16), np.int32)
+    metac = meta_cnt | (1 << 20) | (1 << 21)
+    argsc = [jnp.asarray(x) for x in
+             (r1c, r2, iota, iota, metac, wsel, np.full(NC, S + 1, np.int32))]
+    t_move_copy = timeit(lambda: move_pass(
+        rec, *argsc, C, W, wcnt, S + 1, F, B, group))
+    print(f"move_all_copy={t_move_copy*1e3:.1f}ms "
+          f"({t_move_copy/N*1e9:.2f} ns/row)", flush=True)
+
+    # full hist pass
+    slots = np.zeros(NC, np.int32)
+    slots[nc_data:] = S + 1
+    t_hist = timeit(lambda: slot_hist_pass(
+        rec, jnp.asarray(slots), jnp.asarray(meta_cnt), S + 1, F, B, C,
+        group, wcnt))
+    print(f"hist_full={t_hist*1e3:.1f}ms ({t_hist/N*1e9:.2f} ns/row)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
