@@ -35,8 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.aligned import (R_COPY, R_DL, R_MT, R_SHIFT, lane_layout,
-                           move_pass, pack_records, slot_hist_pass)
+from ..ops.aligned import (R_COPY, R_DL, R_MT, R_SHIFT, count_pass,
+                           lane_layout, move_pass, pack_records,
+                           slot_hist_pass)
 from ..ops.histogram import NUM_HIST_STATS
 from .device_learner import (BF_GAIN, BF_LG, BF_LH, BF_LOUT, BF_RG, BF_RH,
                              BF_ROUT, BF_W, BI_DEFLEFT, BI_FEAT, BI_ISCAT,
@@ -102,11 +103,12 @@ class AlignedEngine:
     """
 
     def __init__(self, learner, objective, interpret: bool = False,
-                 init_row_scores=None):
+                 init_row_scores=None, bagged: bool = False):
         self.learner = learner
         self.objective = objective
         self.cfg = learner.cfg
         self.interpret = interpret
+        self.bagged = bagged
         # 512 measured best on v5e at 10.5M rows: 256 halves the
         # permutation matmul but doubles grid/DMA/glue fixed costs
         # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
@@ -121,8 +123,8 @@ class AlignedEngine:
             else np.zeros(learner.n, np.float32)
         weight = objective._weight_np
         rec, self.wcnt, self.W, cnts = pack_records(
-            bins, label, weight, self.C)
-        self.lanes, _ = lane_layout(self.wcnt)
+            bins, label, weight, self.C, with_bag=bagged)
+        self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged)
         self.n = learner.n
         L = self.cfg.num_leaves
         self.S = spec_slots(L, float(getattr(self.cfg, "tpu_level_spec",
@@ -167,6 +169,11 @@ class AlignedEngine:
         w = (_f32(rec[:, ln["weight"], :])
              if self.objective.weight is not None else None)
         g, h = self._pgrad(score, label, w)
+        if self.bagged:
+            # out-of-bag rows contribute nothing to sums/histograms
+            bag = _f32(rec[:, ln["bag"], :])
+            g = g * bag
+            h = h * bag
         rec = rec.at[:, ln["grad"], :].set(_i32(g))
         rec = rec.at[:, ln["hess"], :].set(_i32(h))
         return rec
@@ -181,6 +188,9 @@ class AlignedEngine:
         cfg = self.cfg
         C, NC, S = self.C, self.NC, self.S
         Sm1 = S - 1
+        # per-round split cap = compact hist-store height: must fit the
+        # move kernel's VMEM-resident store even at B=256 (~44 MB at 256)
+        K = min(Sm1, 256)
         Lm1_commit = max(self.cfg.num_leaves - 1, 1)
         F = lr.num_features
         B = lr.max_bin_global
@@ -198,6 +208,8 @@ class AlignedEngine:
         mt_dev = jnp.asarray(mt_np)
         group = 8 if B <= 64 else 4
         interpret = self.interpret
+        bagged = self.bagged
+        bag_lane = ln["bag"] if bagged else -1
         axis = lr.axis_name
         dp = axis is not None and lr.parallel_mode == "data"
 
@@ -301,13 +313,12 @@ class AlignedEngine:
             # ids, so begins are MONOTONIC in slot id: the containing slot
             # of chunk c is the last slot with begin <= c (zero-width
             # slots share their begin with the next wide one and lose the
-            # tie). searchsorted is O(NC log S) vs the O(S*NC) broadcast.
-            # begin is monotone over slot ids (cumsum layout; dead slots
-            # hold NC and live past the frontier), and among equal begins
-            # only the LAST can have nonzero width — searchsorted lands on
-            # exactly the containing slot.
-            slot_of = (jnp.searchsorted(begin, chunk_iota,
-                                        side="right") - 1).astype(jnp.int32)
+            # tie). The O(S*NC) broadcast count VECTORIZES on the VPU
+            # (searchsorted lowers to a serial while-loop of gathers —
+            # measured ~1.1 ms per call at NC=22k vs ~0.1 ms for the
+            # broadcast at S=766).
+            slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
+                              .astype(jnp.int32), axis=0) - 1
             slot_of = jnp.clip(slot_of, 0, S)
             end_of = begin[slot_of] + nch[slot_of]
             in_any = ((chunk_iota >= begin[slot_of])
@@ -337,15 +348,22 @@ class AlignedEngine:
                   g_rows=None, h_rows=None):
             if external_grads:
                 rid = jnp.clip(rec[:, ln["rid"], :], 0, self.n - 1)
-                rec = rec.at[:, ln["grad"], :].set(_i32(g_rows[rid]))
-                rec = rec.at[:, ln["hess"], :].set(_i32(h_rows[rid]))
+                ge = g_rows[rid]
+                he = h_rows[rid]
+                if bagged:
+                    bag = _f32(rec[:, ln["bag"], :])
+                    ge = ge * bag
+                    he = he * bag
+                rec = rec.at[:, ln["grad"], :].set(_i32(ge))
+                rec = rec.at[:, ln["hess"], :].set(_i32(he))
             else:
                 rec = self._grad_lanes(rec)
 
             # ---------- root ----------
             root_slots = jnp.zeros(NC, jnp.int32)
-            root_hist_all = slot_hist_pass(rec, root_slots, cnts_pc, S + 1,
+            root_hist_all = slot_hist_pass(rec, root_slots, cnts_pc, 1,
                                            F, B, C, group, wcnt,
+                                           bag_lane=bag_lane,
                                            interpret=interpret)
             root_hist = _gsum(root_hist_all[0])
             root_g = jnp.sum(root_hist[0, :, 0])
@@ -399,7 +417,10 @@ class AlignedEngine:
                  _ncommit, rounds) = state
                 s_ids = jnp.arange(S + 1, dtype=jnp.int32)
                 gains = bestF[:, BF_GAIN]
-                budget = Sm1 - done
+                # K also caps per-round splits: compact hist ids must fit
+                # the VMEM-resident store (dropped needs re-offer next
+                # round via the replay)
+                budget = jnp.minimum(Sm1 - done, K)
                 # NEED-driven speculation: split exactly the slots the
                 # on-device leaf-wise replay flagged as its frontier last
                 # round — early rounds this is every positive leaf, late
@@ -451,8 +472,40 @@ class AlignedEngine:
                 feat = bestI[:, BI_FEAT]
                 wsel_s = feat >> 2
                 shift_s = (feat & 3) * 8
-                left_local = jnp.where(sel, bestI[:, BI_LC],
-                                       leafI[:, LI_COUNT])
+                # route words + chunk meta (shared by the count pass and
+                # the move pass; both read the OLD layout)
+                r1_s = (jnp.clip(bestI[:, BI_THR], 0, 255)
+                        | (shift_s << R_SHIFT)
+                        | (bestI[:, BI_DEFLEFT] << R_DL)
+                        | (mt_dev[feat] << R_MT)
+                        | ((1 - sel.astype(jnp.int32)) << R_COPY))
+                r2_s = (jnp.clip(db_dev[feat], 0, 0xFFFF)
+                        | (jnp.clip(nb_dev[feat], 0, 0xFFFF) << 16))
+                r1_pc = r1_s[slot_of]
+                r2_pc = r2_s[slot_of]
+                wsel_pc = wsel_s[slot_of]
+                meta_pc = (cnt_of
+                           | (first.astype(jnp.int32) << 20)
+                           | (last.astype(jnp.int32) << 21))
+                if bagged:
+                    # the histogram count channel is IN-BAG only under
+                    # bagging: the physical layout needs exact i32
+                    # whole-row counts from the dedicated count pass
+                    # (streams just the split-word sublane; the R_COPY
+                    # bit is never read there — counted chunks are
+                    # selected splits, whose copy bit is 0)
+                    ks_s = jnp.where(sel, jnp.clip(selrank, 0, K - 1), K)
+                    ks_pc = jnp.where(in_any & sel[slot_of],
+                                      ks_s[slot_of], K)
+                    phys = count_pass(rec, r1_pc, r2_pc, meta_pc,
+                                      wsel_pc, ks_pc, K, C,
+                                      interpret=interpret)
+                    left_local = jnp.where(
+                        sel, phys[jnp.clip(selrank, 0, K - 1)],
+                        leafI[:, LI_COUNT])
+                else:
+                    left_local = jnp.where(sel, bestI[:, BI_LC],
+                                           leafI[:, LI_COUNT])
                 right_local = leafI[:, LI_COUNT] - left_local
 
                 # ---- new layout
@@ -465,43 +518,29 @@ class AlignedEngine:
                 new_begin = jnp.concatenate(
                     [jnp.zeros(1, jnp.int32), jnp.cumsum(nch_new)[:-1]])
 
-                # ---- move pass params per chunk (OLD layout)
-                r1_s = (jnp.clip(bestI[:, BI_THR], 0, 255)
-                        | (shift_s << R_SHIFT)
-                        | (bestI[:, BI_DEFLEFT] << R_DL)
-                        | (mt_dev[feat] << R_MT)
-                        | ((1 - sel.astype(jnp.int32)) << R_COPY))
+                # ---- move destinations per chunk (NEW layout)
                 copy_pc = ~sel[slot_of] & in_any
                 # unsplit blocks shift as WHOLE chunks: per-chunk direct
                 # destination (kernel bypasses all compute with one DMA)
                 direct_pc = (new_begin[slot_of] + chunk_iota
                              - leafI[:, LI_BEGIN][slot_of])
-                r2_s = (jnp.clip(db_dev[feat], 0, 0xFFFF)
-                        | (jnp.clip(nb_dev[feat], 0, 0xFFFF) << 16))
                 bl_s = new_begin
                 br_s = jnp.where(sel, new_begin[safe_right], new_begin)
-                wsel_pc = wsel_s[slot_of]
-                r1_pc = r1_s[slot_of]
-                r2_pc = r2_s[slot_of]
                 bl_pc = jnp.where(copy_pc, direct_pc, bl_s[slot_of])
                 br_pc = br_s[slot_of]
-                meta_pc = (cnt_of
-                           | (first.astype(jnp.int32) << 20)
-                           | (last.astype(jnp.int32) << 21))
-                # smaller-child hist slots, fused into the move pass
+                # smaller-child hist slots (COMPACT per-round ids =
+                # selection rank, so the move pass's VMEM-resident store
+                # stays small), fused into the move pass
                 smaller_is_left = bestI[:, BI_LC] <= bestI[:, BI_RC]
-                smaller_slot = jnp.where(smaller_is_left, s_ids, safe_right)
                 hslot_s = jnp.where(
-                    sel, smaller_slot
+                    sel, jnp.clip(selrank, 0, K - 1)
                     | ((~smaller_is_left).astype(jnp.int32) << 24),
-                    S + 1)
-                # gate on RANGE membership, not count: the block's final
-                # (fin) flush fires on its LAST chunk, which can hold zero
-                # NEW rows while the staging still drains the remainder
-                hslots_pc = jnp.where(in_any, hslot_s[slot_of], S + 1)
+                    K)
+                hslots_pc = jnp.where(in_any, hslot_s[slot_of], K)
                 rec, hout = move_pass(rec, r1_pc, r2_pc, bl_pc, br_pc,
                                       meta_pc, wsel_pc, hslots_pc, C, W,
-                                      wcnt, S + 1, F, B, group,
+                                      wcnt, K, F, B, group,
+                                      bag_lane=bag_lane,
                                       interpret=interpret)
 
                 # ---- updated tables (begins relaid for ALL slots)
@@ -563,7 +602,7 @@ class AlignedEngine:
                 # ---- new per-chunk counts + child histograms
                 slot_of2, cnt_of2, _, _, _ = chunk_maps(leafI, exists2)
                 cnts_pc = cnt_of2
-                sm_hist = _gsum(hout[jnp.where(sel, smaller_slot, S)])
+                sm_hist = _gsum(hout[jnp.clip(selrank, 0, K - 1)])
                 lg_hist = hist_store[s_ids] - sm_hist
                 left_hist = jnp.where(
                     smaller_is_left[:, None, None, None], sm_hist, lg_hist)
@@ -787,6 +826,22 @@ class AlignedEngine:
             node, slot = lax.while_loop(cond, body, (node0, slot0))
             gate = applied.astype(jnp.float32)
             return score + cover[jnp.clip(slot, 0, S)] * scale * gate
+        return fn
+
+    def set_bag(self, mask_rows):
+        """Re-ingest a per-row 0/1 bagging mask into the bag lane (one
+        streaming pass; called on bagging_freq boundaries)."""
+        fn = self._program("setbag", self._set_bag_program, donate=(0,))
+        self.rec = fn(self.rec, jnp.asarray(mask_rows, jnp.float32))
+
+    def _set_bag_program(self):
+        ln = self.lanes
+        n = self.n
+
+        def fn(rec, mask):
+            rid = jnp.clip(rec[:, ln["rid"], :], 0, n)
+            vals = jnp.concatenate([mask, jnp.zeros(1, jnp.float32)])[rid]
+            return rec.at[:, ln["bag"], :].set(_i32(vals))
         return fn
 
     def set_row_scores(self, row_scores):

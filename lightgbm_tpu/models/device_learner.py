@@ -877,15 +877,17 @@ class DeviceTreeLearner:
                 and (objective.point_grad_fn() is not None
                      or self.n >= 4_000_000))
 
-    def aligned_engine(self, objective, init_row_scores=None):
+    def aligned_engine(self, objective, init_row_scores=None,
+                       bagged=False):
         """The persistent AlignedEngine for (this learner, objective)."""
         eng = getattr(self, "_aligned_eng", None)
-        if eng is None or eng.objective is not objective:
+        if eng is None or eng.objective is not objective \
+                or getattr(eng, "bagged", False) != bagged:
             from .aligned_builder import AlignedEngine
             eng = AlignedEngine(
                 self, objective,
                 interpret=bool(self.cfg.tpu_aligned_interpret),
-                init_row_scores=init_row_scores)
+                init_row_scores=init_row_scores, bagged=bagged)
             self._aligned_eng = eng
         return eng
 

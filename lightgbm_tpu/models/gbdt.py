@@ -415,7 +415,6 @@ class GBDT:
                 and self.num_tree_per_iteration == 1
                 and self._class_need_train[0]
                 and self.train_data.num_features > 0
-                and not self._will_bag()
                 and self.objective is not None
                 and not getattr(self.objective, "is_renew_tree_output",
                                 False)
@@ -442,8 +441,21 @@ class GBDT:
         if eng is None:
             eng = self.learner.aligned_engine(
                 self.objective,
-                init_row_scores=np.asarray(self.train_score.score[0]))
+                init_row_scores=np.asarray(self.train_score.score[0]),
+                bagged=self._will_bag())
             self._aligned_eng_ref = eng
+        if self._will_bag() and self.iter % cfg.bagging_freq == 0:
+            # resample on bagging_freq boundaries and re-ingest the 0/1
+            # mask into the bag lane (gbdt.cpp:209-275; the engine's
+            # histograms and gradients honor it, the physical layout
+            # keeps ALL rows so out-of-bag rows still get scores)
+            self._bagging(self.iter)
+            mask = np.zeros(self.num_data, np.float32)
+            if self.bag_data_indices is not None:
+                mask[self.bag_data_indices] = 1.0
+            else:
+                mask[:] = 1.0
+            eng.set_bag(mask)
         fmask = self.learner.feature_mask()
         out = self._dispatch_aligned(eng, fmask)
         # resolve the PREVIOUS iteration while this one runs on device
@@ -453,7 +465,8 @@ class GBDT:
             # same (failed) tree on unchanged scores — discard it, grow
             # the failed tree exactly, then dispatch this iteration fresh
             eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
-            stop = self._aligned_fallback_iter(redo[1], eng, redo[2])
+            stop = self._aligned_fallback_iter(redo[1], eng, redo[2],
+                                               redo[3], redo[4])
             if stop:
                 return True
             out = self._dispatch_aligned(eng, fmask)
@@ -464,8 +477,12 @@ class GBDT:
         self.models.append(lazy)
         self._pending_numsplits.append(ncommit_dev)
         self.iter += 1
+        # the bag draw is stashed with the pending iteration: a fallback
+        # must rebuild tree i on the SAME bag mask the device build used,
+        # not on the next iteration's freshly-resampled one
         self._aligned_pending = (exact_dev, list(init_scores),
-                                 fmask if fmask is None else fmask.copy())
+                                 fmask if fmask is None else fmask.copy(),
+                                 self.bag_data_indices, self.bag_data_cnt)
         # valid-set scores: walk the committed tree ON DEVICE from the
         # spec, still pipelined — the walk is gated by the program's own
         # applied flag, so a dispatch the host later discards (inexact
@@ -504,7 +521,7 @@ class GBDT:
         if pending is None:
             return None
         self._aligned_pending = None
-        exact_dev, init_scores, fmask = pending
+        exact_dev, init_scores, fmask, bag_idx, bag_cnt = pending
         if bool(exact_dev):
             return None
         # discard the speculative tree
@@ -514,25 +531,44 @@ class GBDT:
         if final:
             eng = self._aligned_eng_ref
             eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
-            stop = self._aligned_fallback_iter(init_scores, eng, fmask)
+            stop = self._aligned_fallback_iter(init_scores, eng, fmask,
+                                               bag_idx, bag_cnt)
             return ("fellback", stop)
-        return ("redo", init_scores, fmask)
+        return ("redo", init_scores, fmask, bag_idx, bag_cnt)
 
-    def _aligned_fallback_iter(self, init_scores, eng, fmask) -> bool:
+    def _aligned_fallback_iter(self, init_scores, eng, fmask,
+                               bag_idx=None, bag_cnt=0) -> bool:
         # (callers guarantee no unresolved pending iteration here)
         """Exact leaf-wise tree for an iteration whose speculative build
         could not be replayed exactly (the aligned analogue of the level
-        builder's fallback)."""
+        builder's fallback). `bag_idx`/`bag_cnt` = the bag draw the
+        failed device build trained on."""
         cfg = self.cfg
         self._sync_train_score()
         gdev, hdev = self._gradients()
-        idxs, rec = self.learner.train_fresh(gdev[0], hdev[0], fmask)
+        bagged = self._will_bag() and bag_idx is not None
+        if bagged:
+            # mirror the fused bagged branch: partition over the bagged
+            # subset, score update via traversal (covers OOB rows too)
+            idxs, count = self.learner.init_root_partition(
+                bag_idx, bag_cnt)
+            idxs, rec = self.learner.train(gdev[0], hdev[0], idxs, count,
+                                           fmask)
+        else:
+            idxs, rec = self.learner.train_fresh(gdev[0], hdev[0], fmask)
         lazy = LazyTree(rec, self.shrinkage_rate, init_scores[0],
                         self.learner, max(cfg.num_leaves - 1, 1))
         self.models.append(lazy)
-        self.train_score.score = self.learner.add_score_from_partition(
-            self.train_score.score, 0, rec, idxs, self.shrinkage_rate)
-        self._apply_record_to_valid_scores(rec)
+        if bagged:
+            trav = traversal_arrays(rec, max(cfg.num_leaves - 1, 1))
+            self.train_score.score = self.train_score.score.at[0].set(
+                self.learner.add_score(self.train_score.score[0], trav,
+                                       self.shrinkage_rate))
+            self._apply_record_to_valid_scores(rec, trav=trav)
+        else:
+            self.train_score.score = self.learner.add_score_from_partition(
+                self.train_score.score, 0, rec, idxs, self.shrinkage_rate)
+            self._apply_record_to_valid_scores(rec)
         eng.set_row_scores(self.train_score.score[0])
         self._train_score_stale = False
         self._pending_numsplits.append(rec.num_splits)

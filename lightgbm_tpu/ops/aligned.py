@@ -83,17 +83,21 @@ def aligned_num_chunks(n: int, cfg, spec_slots: int) -> int:
     return (n + C - 1) // C + spec_slots + 2
 
 
-def lane_layout(wcnt: int):
+def lane_layout(wcnt: int, with_bag: bool = False):
     """(lane indices, padded W) for a record with `wcnt` bin words."""
     ls = wcnt
+    lanes = dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
+                 rid=ls + 4, weight=ls + 5)
     w = wcnt + 6
+    if with_bag:
+        lanes["bag"] = w
+        w += 1
     w_pad = ((w + 7) // 8) * 8
-    return dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
-                rid=ls + 4, weight=ls + 5), w_pad
+    return lanes, w_pad
 
 
 def pack_records(bins: np.ndarray, label: np.ndarray,
-                 weight, chunk: int):
+                 weight, chunk: int, with_bag: bool = False):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
@@ -101,7 +105,7 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     """
     n, f = bins.shape
     wcnt = (f + 3) // 4
-    lanes, w_pad = lane_layout(wcnt)
+    lanes, w_pad = lane_layout(wcnt, with_bag)
     nc = (n + chunk - 1) // chunk
     n_pad = nc * chunk
     padded = np.zeros((n_pad, wcnt * 4), np.uint8)
@@ -116,6 +120,8 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     wv = np.ones(n, np.float32) if weight is None \
         else np.asarray(weight, np.float32)
     rec[:n, lanes["weight"]] = wv.view(np.int32)
+    if with_bag:
+        rec[:n, lanes["bag"]] = np.ones(n, np.float32).view(np.int32)
     rec3 = np.ascontiguousarray(
         rec.reshape(nc, chunk, w_pad).transpose(0, 2, 1))
     cnts = np.full(nc, chunk, np.int32)
@@ -152,15 +158,18 @@ def _goes_left(binv, r1, r2, valid):
 
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  hslot_ref, rec_ref, out_ref, hist_ref, stag, fbuf,
-                 cur_ref, sems, *, chunk, w_pad, wcnt, num_features,
-                 b_pad, group, dummy):
+                 hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
+                 num_features, b_pad, group, dummy, bag_lane):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
     rings (exact byte-plane one-hot matmul), flush full chunks to dynamic
-    destination chunks, and accumulate the smaller child's histogram from
-    each flushed chunk. COPY chunks (unsplit blocks): one buffered DMA to
-    the prefetched direct destination, no compute.
+    destination chunks, and accumulate the smaller child's histogram
+    DIRECTLY from the chunk's smaller-side rows into a VMEM-resident
+    store indexed by COMPACT per-round slot ids (constant out-spec: the
+    whole [K+1, ...] store lives in VMEM across the grid and flushes
+    once). COPY chunks (unsplit blocks): one buffered DMA to the
+    prefetched direct destination, no compute.
 
     Flushes are ASYNC: each staging half is copied to one of two per-side
     flush buffers and DMA'd without waiting; a buffer is reused only
@@ -180,6 +189,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         # flags (4..9) and saved destinations (10..15) before any use
         for j in range(16):
             cur_ref[j] = 0
+        hist_ref[...] = jnp.zeros_like(hist_ref)
 
     @pl.when(((meta >> 20) & 1) != 0)     # first chunk of block
     def _():
@@ -187,12 +197,10 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         cur_ref[1] = 0
         cur_ref[2] = 0
         cur_ref[3] = 0
-
-    # smaller-child histogram accumulator: zero on block entry (the out
-    # block index is constant across one block's chunks)
-    @pl.when(((meta >> 20) & 1) != 0)
-    def _():
-        hist_ref[...] = jnp.zeros_like(hist_ref)
+        # per-block hist accumulator: STATIC address per chunk (a
+        # dynamic-index RMW per chunk measured 3x slower); flushed to
+        # the compact store once per block on its last chunk
+        hacc[...] = jnp.zeros_like(hacc)
 
     rec = rec_ref[0]                                  # [W, C]
     pos = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
@@ -208,11 +216,18 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         cur_ref[4 + slot] = 0
 
     def hist_flushed(rows, nvalid):
-        """Accumulate the smaller-child histogram over a flushed [W, C]
-        chunk (first nvalid rows valid) — exactly half the tree's rows
-        get histogrammed, fused into the move (no separate pass)."""
+        """Accumulate a flushed [W, C] chunk of the smaller child (first
+        nvalid rows valid) into the per-block accumulator: flushed
+        buffers hold the side's rows COMPACTED, so the one-hot work runs
+        at full density on exactly the smaller child's rows."""
         posh = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
         take = posh < nvalid
+        if bag_lane >= 0:
+            # bagging: the histogram's g/h/cnt stats cover IN-BAG rows
+            # only (gbdt.cpp:209-275 trains on the bagged subset)
+            bagv = lax.bitcast_convert_type(rows[bag_lane, :],
+                                            jnp.float32)
+            take = take & (bagv > 0.5)
         g = lax.bitcast_convert_type(rows[wcnt + 2, :], jnp.float32)
         h = lax.bitcast_convert_type(rows[wcnt + 3, :], jnp.float32)
         gm = jnp.where(take, g, 0.0)
@@ -235,7 +250,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
             contrib = lax.dot_general(pay6, onehot,
                                       (((1,), (1,)), ((), ())),
                                       preferred_element_type=jnp.float32)
-            hist_ref[0, gi] += contrib
+            hacc[gi] += contrib
 
     # ---- copy fast-path: unsplit blocks shift as whole chunks — one
     # buffered DMA to the prefetched direct destination (bl), no compute
@@ -269,6 +284,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
         left = _goes_left(binv, r1, r2_ref[i], valid)
 
+        # ranks via one triangular matmul (measured FASTER on the MXU
+        # than log2(C) pltpu.roll prefix sums: 3.33 vs 3.82 ns/row)
         li = left.astype(jnp.bfloat16)[None, :]
         vi = valid.astype(jnp.bfloat16)[None, :]
         both = jnp.concatenate([li, vi], axis=0)          # [2, C]
@@ -356,6 +373,10 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         flush_side(0, 2, bl_i, new_l)
         flush_side(1, 3, br_i, new_r)
 
+        @pl.when((is_last != 0) & ((hs & 0xFFFFFF) != dummy))
+        def _():
+            hist_ref[hs & 0xFFFFFF] += hacc[...]
+
         @pl.when(is_last != 0)
         def _():
             cur_ref[2] = 0
@@ -371,10 +392,10 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
-    "group", "interpret"))
+    "group", "bag_lane", "interpret"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
               w_pad, wcnt, num_slots, num_features, b_pad, group,
-              interpret=False):
+              bag_lane=-1, interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -387,18 +408,23 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
     per-chunk routing (see module docstring bit layouts; wsel = split
     word lane index of the chunk's block). hslots[i] packs the smaller
     child's accumulation slot | side << 24 (side 0 = left rows of the
-    chunk are the smaller child); slot == num_slots skips.
+    chunk are the smaller child); slot == num_slots skips. Slots are
+    COMPACT per-round ids (0..k-1): the whole [num_slots+1, ...] store
+    stays VMEM-resident across the grid (num_slots <= ~256 so it fits
+    at B=256), so callers must remap tree slots to the round's selected
+    split ranks.
 
-    Returns (records_out, hist[num_slots+1, F, b_pad, 3]). Chunks not
+    Returns (records_out, hist[num_slots, F, b_pad, 3]). Chunks not
     covered by the new layout keep stale rows; hist slots never present
-    in hslots hold garbage — consumers mask both.
+    in hslots are zero.
     """
     nc = records.shape[0]
     dummy = num_slots
     ngroups = (num_features + group - 1) // group
     kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
                                wcnt=wcnt, num_features=num_features,
-                               b_pad=b_pad, group=group, dummy=dummy)
+                               b_pad=b_pad, group=group, dummy=dummy,
+                               bag_lane=bag_lane)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -410,13 +436,15 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec((1, ngroups, 6, group * b_pad),
-                         lambda i, a, b, c, d, e:
-                         (e[i] & 0xFFFFFF, 0, 0, 0)),
+            # constant index map: the compact hist store is resident in
+            # VMEM for the whole pass and written back once at the end
+            pl.BlockSpec((num_slots + 1, ngroups, 6, group * b_pad),
+                         lambda i, a, b, c, d, e: (0, 0, 0, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
             pltpu.VMEM((6, w_pad, chunk), jnp.int32),   # flush+copy bufs
+            pltpu.VMEM((ngroups, 6, group * b_pad), jnp.float32),
             pltpu.SMEM((16,), jnp.int32),
             pltpu.SemaphoreType.DMA((6,)),
         ],
@@ -441,23 +469,103 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
 
 
 # ---------------------------------------------------------------------------
+# physical left-count pass
+# ---------------------------------------------------------------------------
+def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, rec_ref,
+                  out_ref, cacc, *, chunk, dummy):
+    """Exact i32 count of PHYSICAL rows routed left per selected split.
+
+    Streams only each block's split-word sublane (4 B/row). Needed when
+    the histogram count channel cannot drive the physical layout: bagging
+    (counts there are in-bag only, gbdt.cpp:209-275) or n > 2^24 (f32
+    count sums lose exactness)."""
+    i = pl.program_id(0)
+    meta = meta_ref[i]
+
+    @pl.when(i == 0)
+    def _():
+        for k in range(out_ref.shape[0]):     # SMEM table: scalar clears
+            out_ref[k] = 0
+
+    @pl.when(((meta >> 20) & 1) != 0)
+    def _():
+        cacc[0] = 0
+
+    @pl.when(ks_ref[i] != dummy)
+    def _():
+        # the fetched block is an 8-sublane window containing the split
+        # word (TPU blocks must be 8-sublane-divisible); pick the word
+        # with a static select chain on wsel & 7
+        wsub = wsel_ref[i] & 7
+        word = rec_ref[0, 0]
+        for wj in range(1, 8):
+            word = jnp.where(wsub == wj, rec_ref[0, wj], word)
+        r1 = r1_ref[i]
+        binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
+        pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
+        valid = pos < (meta & ((1 << 20) - 1))
+        left = _goes_left(binv, r1, r2_ref[i], valid)
+        cacc[0] = cacc[0] + jnp.sum(left.astype(jnp.int32))
+
+        @pl.when(((meta >> 21) & 1) != 0)          # block's last chunk
+        def _():
+            out_ref[ks_ref[i]] += cacc[0]
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "chunk",
+                                             "interpret"))
+def count_pass(records, r1, r2, meta, wsel, kslots, num_slots, chunk,
+               interpret=False):
+    """[num_slots] i32 physical left counts per compact slot id.
+
+    kslots[i] = compact id of chunk i's selected split (num_slots =
+    skip); r1/r2/meta/wsel as for move_pass (copy bit must be CLEAR for
+    counted chunks)."""
+    nc = records.shape[0]
+    w_pad = records.shape[1]
+    kernel = functools.partial(_count_kernel, chunk=chunk,
+                               dummy=num_slots)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nc,),
+        in_specs=[pl.BlockSpec((1, 8, chunk),
+                               lambda i, a, b, m, w, k: (i, w[i] >> 3, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((8,), jnp.int32)],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slots + 1,), jnp.int32),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        interpret=interpret,
+    )(r1, r2, meta, wsel, kslots, records)
+    return out[:num_slots]
+
+
+# ---------------------------------------------------------------------------
 # slot-mapped histogram pass
 # ---------------------------------------------------------------------------
-def _slot_hist_kernel(slots_ref, zeros_ref, meta_ref, rec_ref, out_ref, *,
-                      num_features, b_pad, group, chunk, wcnt, dummy):
+def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
+                      num_features, b_pad, group, chunk, wcnt, dummy,
+                      bag_lane):
     i = pl.program_id(0)
 
-    @pl.when(zeros_ref[i] != 0)
+    @pl.when(i == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
     @pl.when(slots_ref[i] != dummy)
     def _():
         rec = rec_ref[0]                              # [W, C]
+        ks = slots_ref[i]
         g = lax.bitcast_convert_type(rec[wcnt + 2, :], jnp.float32)
         h = lax.bitcast_convert_type(rec[wcnt + 3, :], jnp.float32)
         pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
         valid = pos < (meta_ref[i] & ((1 << 20) - 1))
+        if bag_lane >= 0:
+            bagv = lax.bitcast_convert_type(rec[bag_lane, :], jnp.float32)
+            valid = valid & (bagv > 0.5)
         gm = jnp.where(valid, g, 0.0)
         hm = jnp.where(valid, h, 0.0)
         cnt = valid.astype(jnp.float32)
@@ -479,39 +587,37 @@ def _slot_hist_kernel(slots_ref, zeros_ref, meta_ref, rec_ref, out_ref, *,
             contrib = lax.dot_general(pay6, onehot,
                                       (((1,), (1,)), ((), ())),
                                       preferred_element_type=jnp.float32)
-            out_ref[0, gi] += contrib                 # [6, group*b_pad]
+            out_ref[ks, gi] += contrib                # [6, group*b_pad]
 
 
 @functools.partial(jax.jit, static_argnames=(
     "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
-    "interpret"))
+    "bag_lane", "interpret"))
 def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
-                   chunk, group, wcnt, interpret=False):
-    """hist[num_slots+1, F, b_pad, 3] over the record matrix.
+                   chunk, group, wcnt, bag_lane=-1, interpret=False):
+    """hist[num_slots, F, b_pad, 3] over the record matrix.
 
-    slots[i] maps chunk i to its accumulation slot; chunks mapped to the
-    DUMMY slot (== num_slots) are skipped (their block's histogram comes
-    from parent-minus-sibling subtraction). Chunks of one slot must be
-    CONSECUTIVE in the grid (blocks are chunk ranges, so they are); a
-    slot's first chunk zeroes the block. Slots never visited keep garbage —
-    callers must only read slots present in the map.
+    slots[i] maps chunk i to its accumulation slot (a COMPACT id —
+    num_slots must be small enough that the whole store fits VMEM, which
+    holds for the root pass and per-round selections); chunks mapped to
+    the DUMMY slot (== num_slots) are skipped. The store is VMEM-resident
+    across the grid (constant out-spec) and zeroed once, so unvisited
+    slots read as zero and chunk order is unconstrained.
     """
     nc = records.shape[0]
     dummy = num_slots
     ngroups = (num_features + group - 1) // group
-    zeros = jnp.concatenate([jnp.ones(1, jnp.int32),
-                             (slots[1:] != slots[:-1]).astype(jnp.int32)])
     kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
                                b_pad=b_pad, group=group, chunk=chunk,
-                               wcnt=wcnt, dummy=dummy)
+                               wcnt=wcnt, dummy=dummy, bag_lane=bag_lane)
     w_pad = records.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=2,
         grid=(nc,),
         in_specs=[pl.BlockSpec((1, w_pad, chunk),
-                               lambda i, s, z, m: (i, 0, 0))],
-        out_specs=pl.BlockSpec((1, ngroups, 6, group * b_pad),
-                               lambda i, s, z, m: (s[i], 0, 0, 0)),
+                               lambda i, s, m: (i, 0, 0))],
+        out_specs=pl.BlockSpec((num_slots + 1, ngroups, 6, group * b_pad),
+                               lambda i, s, m: (0, 0, 0, 0)),
     )
     out = pl.pallas_call(
         kernel,
@@ -520,7 +626,7 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
             (num_slots + 1, ngroups, 6, group * b_pad), jnp.float32),
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
         interpret=interpret,
-    )(slots, zeros, meta, records)
+    )(slots, meta, records)
     out = out.reshape(num_slots + 1, ngroups, 6, group, b_pad)
     out = out[:, :, :3] + out[:, :, 3:]
     out = jnp.moveaxis(out, 2, 4)

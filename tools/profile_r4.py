@@ -90,7 +90,11 @@ def main():
           f"min={min(rounds)} max={max(rounds)}) "
           f"n_exec(mean={np.mean(nexec):.0f} min={min(nexec)} "
           f"max={max(nexec)})", flush=True)
-    print(f"ms_per_round={dt*1e3/np.mean(rounds):.1f}", flush=True)
+    print(f"ms_per_round={dt*1e3/np.mean(rounds):.1f} "
+          f"fallbacks={getattr(eng, 'fallbacks', 0)} "
+          f"nexec_last10={nexec[-10:]}", flush=True)
+    if os.environ.get("SKIP_KBENCH"):
+        return
 
     # ---- standalone pass benches on the engine's real state
     from lightgbm_tpu.ops.aligned import move_pass, slot_hist_pass
@@ -126,9 +130,10 @@ def main():
     baser = np.full(NC, nc_data // 2, np.int32)
     wsel = np.zeros(NC, np.int32)
     hsl = np.zeros(NC, np.int32)   # accumulate slot 0, left side
+    KB = 256                       # compact-store height (kernel contract)
     args = [jnp.asarray(x) for x in (r1, r2, basel, baser, meta, wsel, hsl)]
     t_move_split = timeit(lambda: move_pass(
-        rec, *args, C, W, wcnt, S + 1, F, B, group))
+        rec, *args, C, W, wcnt, KB, F, B, group))
     print(f"move_all_split={t_move_split*1e3:.1f}ms "
           f"({t_move_split/N*1e9:.2f} ns/row)", flush=True)
 
@@ -136,17 +141,17 @@ def main():
     r1c = np.full(NC, (1 << 16), np.int32)
     metac = meta_cnt | (1 << 20) | (1 << 21)
     argsc = [jnp.asarray(x) for x in
-             (r1c, r2, iota, iota, metac, wsel, np.full(NC, S + 1, np.int32))]
+             (r1c, r2, iota, iota, metac, wsel, np.full(NC, KB, np.int32))]
     t_move_copy = timeit(lambda: move_pass(
-        rec, *argsc, C, W, wcnt, S + 1, F, B, group))
+        rec, *argsc, C, W, wcnt, KB, F, B, group))
     print(f"move_all_copy={t_move_copy*1e3:.1f}ms "
           f"({t_move_copy/N*1e9:.2f} ns/row)", flush=True)
 
     # full hist pass
     slots = np.zeros(NC, np.int32)
-    slots[nc_data:] = S + 1
+    slots[nc_data:] = 1
     t_hist = timeit(lambda: slot_hist_pass(
-        rec, jnp.asarray(slots), jnp.asarray(meta_cnt), S + 1, F, B, C,
+        rec, jnp.asarray(slots), jnp.asarray(meta_cnt), 1, F, B, C,
         group, wcnt))
     print(f"hist_full={t_hist*1e3:.1f}ms ({t_hist/N*1e9:.2f} ns/row)",
           flush=True)

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Prototype: within-chunk compaction one-hot [C,C] + dynamic-roll ring
+placement vs the production [C,4C] route matmul. Measures ns/row of the
+split path core on synthetic chunks (no flush DMAs — both variants do the
+same staging write, so the delta is the routing cost)."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C = 512
+W = 16
+N_CHUNKS = 20000
+
+
+def _common(rec, thr):
+    binv = (rec[0, :] >> 0) & 255
+    pos = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
+    valid = pos < C
+    left = (binv <= thr) & valid
+    li = left.astype(jnp.bfloat16)[None, :]
+    vi = valid.astype(jnp.bfloat16)[None, :]
+    both = jnp.concatenate([li, vi], axis=0)
+    iota_s = lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    iota_d = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    tri = (iota_s < iota_d).astype(jnp.bfloat16)
+    ranks = lax.dot_general(both, tri, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    rank_l = ranks[0].astype(jnp.int32)
+    rank_v = ranks[1].astype(jnp.int32)
+    k_l = jnp.sum(left.astype(jnp.int32))
+    k_v = jnp.sum(valid.astype(jnp.int32))
+    return left, valid, rank_l, rank_v - rank_l, k_l, k_v
+
+
+def _planes(rec):
+    return jnp.concatenate(
+        [((rec >> (8 * b)) & 255).astype(jnp.bfloat16)
+         for b in range(4)], axis=0)                  # [4W, C]
+
+
+def _unpack(mi):
+    return (mi[:W] | (mi[W:2 * W] << 8) | (mi[2 * W:3 * W] << 16)
+            | (mi[3 * W:] << 24))
+
+
+def kernel_route4c(rec_ref, out_ref, stag, cur_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        cur_ref[0] = 0
+        cur_ref[1] = 0
+
+    rec = rec_ref[0]
+    left, valid, rank_l, rank_r, k_l, k_v = _common(rec, 31)
+    cur_l = cur_ref[0]
+    cur_r = cur_ref[1]
+    dst = jnp.where(left, (cur_l + rank_l) % (2 * C),
+                    2 * C + (cur_r + rank_r) % (2 * C))
+    dst = jnp.where(valid, dst, 4 * C + 5)
+    planes = _planes(rec)
+    iota_4c = lax.broadcasted_iota(jnp.int32, (C, 4 * C), 1)
+    route = (dst[:, None] == iota_4c).astype(jnp.bfloat16)
+    moved = lax.dot_general(planes, route, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    mi = moved.astype(jnp.int32)
+    mrows = _unpack(mi)
+    pos4 = lax.broadcasted_iota(jnp.int32, (1, 4 * C), 1)[0]
+    lo_l = cur_l % (2 * C)
+    in_l = (pos4 >= lo_l) & (pos4 < lo_l + k_l) & (pos4 < 2 * C)
+    pr = pos4 - 2 * C
+    lo_r = cur_r % (2 * C)
+    in_r = (pr >= lo_r) & (pr < lo_r + (k_v - k_l)) & (pr >= 0)
+    mask = (in_l | in_r)[None, :]
+    stag[...] = jnp.where(mask, mrows, stag[...])
+    cur_ref[0] = (cur_l + k_l) % (2 * C)
+    cur_ref[1] = (cur_r + k_v - k_l) % (2 * C)
+    out_ref[0] = stag[:, :C]
+
+
+def kernel_compact_roll(rec_ref, out_ref, stag, cur_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        cur_ref[0] = 0
+        cur_ref[1] = 0
+
+    rec = rec_ref[0]
+    left, valid, rank_l, rank_r, k_l, k_v = _common(rec, 31)
+    # in-chunk compaction: lefts -> [0, k_l), rights -> [k_l, k_v)
+    dstc = jnp.where(left, rank_l, k_l + rank_r)
+    dstc = jnp.where(valid, dstc, C + 5)   # clipped away
+    planes = _planes(rec)
+    iota_c = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    route = (dstc[:, None] == iota_c).astype(jnp.bfloat16)
+    moved = lax.dot_general(planes, route, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    comp = _unpack(moved.astype(jnp.int32))            # [W, C] compacted
+    cur_l = cur_ref[0]
+    cur_r = cur_ref[1]
+    pos2 = lax.broadcasted_iota(jnp.int32, (1, 2 * C), 1)[0]
+    wide = jnp.concatenate([comp, jnp.zeros((W, C), jnp.int32)], axis=1)
+    # lefts: roll so lane 0 lands at cur_l%2C
+    rl = pltpu.roll(wide, cur_l % (2 * C), 1)
+    lo_l = cur_l % (2 * C)
+    in_l = ((pos2 - lo_l) % (2 * C)) < k_l
+    half_l = stag[:, :2 * C]
+    stag[:, :2 * C] = jnp.where(in_l[None, :], rl, half_l)
+    # rights: segment starts at lane k_l in comp; roll by cur_r - k_l
+    rr = pltpu.roll(wide, (cur_r - k_l) % (2 * C), 1)
+    lo_r = cur_r % (2 * C)
+    in_r = ((pos2 - lo_r) % (2 * C)) < (k_v - k_l)
+    half_r = stag[:, 2 * C:]
+    stag[:, 2 * C:] = jnp.where(in_r[None, :], rr, half_r)
+    cur_ref[0] = (cur_l + k_l) % (2 * C)
+    cur_ref[1] = (cur_r + k_v - k_l) % (2 * C)
+    out_ref[0] = stag[:, :C]
+
+
+def bench(kernel, rec):
+    f = pl.pallas_call(
+        kernel,
+        grid=(N_CHUNKS,),
+        in_specs=[pl.BlockSpec((1, W, C), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, W, C), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, W, C), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((W, 4 * C), jnp.int32),
+                        pltpu.SMEM((8,), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )
+    fj = jax.jit(lambda r: f(r))
+    out = fj(rec)
+    np.asarray(jax.device_get(out.reshape(-1)[:1]))
+    K = 6
+    t0 = time.perf_counter()
+    for _ in range(K):
+        out = fj(rec)
+    np.asarray(jax.device_get(out.reshape(-1)[:1]))
+    dt = (time.perf_counter() - t0) / K
+    n = N_CHUNKS * C
+    return dt, dt / n * 1e9
+
+
+def main():
+    rng = np.random.RandomState(0)
+    rec = jnp.asarray(rng.randint(0, 2**31 - 1,
+                                  (N_CHUNKS, W, C)).astype(np.int32))
+    for name, k in (("route4c", kernel_route4c),
+                    ("compact_roll", kernel_compact_roll)):
+        try:
+            dt, ns = bench(k, rec)
+            print(f"{name}: {dt*1e3:.1f}ms ({ns:.2f} ns/row)", flush=True)
+        except Exception as e:
+            print(f"{name} FAILED: {type(e).__name__} {str(e)[:300]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Trace N aligned iterations with jax.profiler and aggregate device op
+durations from the perfetto json. Usage: python tools/trace_r4.py [n]"""
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+MB = 63
+CACHE = f"/tmp/higgs_shape_{N}_{MB}.npz"
+LOG = "/tmp/jaxtrace_r4"
+
+
+def main():
+    import lightgbm_tpu as lgb
+    z = np.load(CACHE)
+    bins, label = z["bins"], z["label"]
+    params = {"objective": "binary", "num_leaves": 255,
+              "learning_rate": 0.1, "max_bin": MB,
+              "min_data_in_leaf": 100, "verbosity": -1,
+              "tpu_level_spec": 3.0}
+    train_set = lgb.Dataset(bins.astype(np.float32), label=label,
+                            params=params).construct()
+    bst = lgb.Booster(params=params, train_set=train_set)
+    gb = bst._gbdt
+    for _ in range(6):
+        gb.train_one_iter()
+    jax.block_until_ready(gb._aligned_eng_ref.rec)
+    os.system(f"rm -rf {LOG}")
+    with jax.profiler.trace(LOG):
+        for _ in range(3):
+            gb.train_one_iter()
+        jax.block_until_ready(gb._aligned_eng_ref.rec)
+
+    files = glob.glob(f"{LOG}/**/*.trace.json.gz", recursive=True)
+    print("trace files:", files, flush=True)
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    for fn in files:
+        with gzip.open(fn, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            # device lanes only: pid names like "/device:TPU:0" appear in
+            # metadata; keep every complete event and let names sort it
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0)
+            agg[name] += dur
+            cnt[name] += 1
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:45]
+    for name, us in top:
+        print(f"{us/3000.0:9.2f} ms/iter  x{cnt[name]//3:<6} {name[:110]}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
