@@ -35,9 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.aligned import (R_COPY, R_DL, R_MT, R_SHIFT, count_pass,
-                           lane_layout, move_pass, pack_records,
-                           slot_hist_pass)
+from ..ops.aligned import (R_CAT, R_COPY, R_DL, R_MT, R_SHIFT,
+                           count_pass, lane_layout, move_pass,
+                           pack_records, slot_hist_pass)
 from ..ops.histogram import NUM_HIST_STATS
 from .device_learner import (BF_GAIN, BF_LG, BF_LH, BF_LOUT, BF_RG, BF_RH,
                              BF_ROUT, BF_W, BI_DEFLEFT, BI_FEAT, BI_ISCAT,
@@ -478,7 +478,15 @@ class AlignedEngine:
                         | (shift_s << R_SHIFT)
                         | (bestI[:, BI_DEFLEFT] << R_DL)
                         | (mt_dev[feat] << R_MT)
-                        | ((1 - sel.astype(jnp.int32)) << R_COPY))
+                        | ((1 - sel.astype(jnp.int32)) << R_COPY)
+                        | (bestI[:, BI_ISCAT] << R_CAT))
+                # compact per-round bitset table for categorical splits
+                # (tiny SMEM prefetch; row K is the never-read pad row)
+                cbits = jnp.zeros((K + 1, 8), jnp.int32).at[
+                    jnp.where(sel, jnp.clip(selrank, 0, K - 1), K)].set(
+                    jnp.where(sel[:, None],
+                              lax.bitcast_convert_type(bestB, jnp.int32),
+                              0)).reshape(-1)
                 r2_s = (jnp.clip(db_dev[feat], 0, 0xFFFF)
                         | (jnp.clip(nb_dev[feat], 0, 0xFFFF) << 16))
                 r1_pc = r1_s[slot_of]
@@ -498,7 +506,7 @@ class AlignedEngine:
                     ks_pc = jnp.where(in_any & sel[slot_of],
                                       ks_s[slot_of], K)
                     phys = count_pass(rec, r1_pc, r2_pc, meta_pc,
-                                      wsel_pc, ks_pc, K, C,
+                                      wsel_pc, ks_pc, cbits, K, C,
                                       interpret=interpret)
                     left_local = jnp.where(
                         sel, phys[jnp.clip(selrank, 0, K - 1)],
@@ -538,8 +546,8 @@ class AlignedEngine:
                     K)
                 hslots_pc = jnp.where(in_any, hslot_s[slot_of], K)
                 rec, hout = move_pass(rec, r1_pc, r2_pc, bl_pc, br_pc,
-                                      meta_pc, wsel_pc, hslots_pc, C, W,
-                                      wcnt, K, F, B, group,
+                                      meta_pc, wsel_pc, hslots_pc, cbits,
+                                      C, W, wcnt, K, F, B, group,
                                       bag_lane=bag_lane,
                                       interpret=interpret)
 

@@ -840,8 +840,9 @@ class DeviceTreeLearner:
     # ------------------------------------------------------------------
     def aligned_mode_ok(self, objective) -> bool:
         """True when the chunk-aligned pipeline (`aligned_builder.py`) can
-        run: TPU pallas (or interpret mode for tests), numerical features,
-        a pointwise single-class objective, serial/data parallelism."""
+        run: TPU pallas (or interpret mode for tests), a pointwise
+        single-class objective, serial parallelism; numerical AND
+        categorical features, with or without bagging (round 4)."""
         mode = self.cfg.tpu_grow_mode
         if mode not in ("auto", "aligned"):
             return False
@@ -868,7 +869,6 @@ class DeviceTreeLearner:
                 and self.num_features > 0
                 and self.cfg.num_leaves >= 2
                 and self.max_bin_global <= 256
-                and not bool(np.any(self.meta["bin_type"] != 0))
                 and objective is not None
                 and objective.num_model_per_iteration == 1
                 # non-pointwise objectives pay a row-order gradient

@@ -65,6 +65,7 @@ R_DL = 13          # bit 13      default_left
 R_MT = 14          # bits 14..15 missing type
 R_COPY = 16        # bit 16      copy-through (unsplit block)
 R_WSEL = 17        # bits 17..24 split word lane of the block
+R_CAT = 25         # bit 25      categorical split (bitset routing)
 # route word 2: default_bin | num_bin << 16
 # meta word: cnt | first << 20 | last << 21
 
@@ -132,13 +133,15 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
 # ---------------------------------------------------------------------------
 # move pass
 # ---------------------------------------------------------------------------
-def _goes_left(binv, r1, r2, valid):
-    """Reference DenseBin::Split routing (dense_bin.hpp:195-255):
-    numerical with missing None/Zero/NaN; copy-through routes all left.
+def _goes_left(binv, r1, r2, valid, catw=None):
+    """Reference DenseBin::Split routing (dense_bin.hpp:195-283):
+    numerical with missing None/Zero/NaN, categorical by bitset
+    membership (Common::FindInBitset); copy-through routes all left.
 
     Pure i32 arithmetic — Mosaic can't broadcast scalar bools into vector
     selects (arith.trunci to i1 fails), so the scalar route bits enter as
-    0/1 integers and the final bool comes from one vector comparison."""
+    0/1 integers and the final bool comes from one vector comparison.
+    `catw` = per-row selected bitset word (vector, from _cat_word)."""
     thr = r1 & 255
     dl = (r1 >> R_DL) & 1                      # scalar 0/1
     mt = (r1 >> R_MT) & 3
@@ -151,14 +154,46 @@ def _goes_left(binv, r1, r2, valid):
     is_def = (mtz * (binv == db).astype(jnp.int32)
               + mtn * (binv == nb - 1).astype(jnp.int32))
     left_i = is_def * dl + (1 - is_def) * base
+    if catw is not None:
+        iscat = (r1 >> R_CAT) & 1              # scalar 0/1
+        cat_i = (catw >> (binv & 31)) & 1      # vector bit test
+        left_i = iscat * cat_i + (1 - iscat) * left_i
     vi = valid.astype(jnp.int32)
     out = copy * vi + (1 - copy) * left_i * vi
     return out != 0
 
 
+def _cat_word(cbits_ref, ks, binv):
+    """Per-row bitset word for a categorical split: cbits_ref is the
+    round's compact [K*8] flat bitset table (SMEM prefetch), ks the
+    block's compact split id."""
+    bw = binv >> 5
+    w = jnp.zeros_like(binv)
+    for j in range(8):
+        w = jnp.where(bw == j, cbits_ref[ks * 8 + j], w)
+    return w
+
+
+
+def _hi_lo6(pay):
+    """Split [3, C] f32 payload rows into an exact [6, C] bf16 (hi, lo)
+    pair via mantissa TRUNCATION: hi = pay with the low 16 mantissa bits
+    zeroed (exactly bf16-representable), lo = bf16(pay - hi). The naive
+    round-to-nearest form `bf16(pay - f32(bf16(pay)))` is silently
+    simplified to 0 by XLA's convert-folding pass, dropping the
+    compensation term and leaving raw bf16 rounding error in the
+    histogram sums (~1e-3 absolute on value-concentrated data); the bit
+    mask is opaque to that pass, and hi + lo reconstructs ~23 bits."""
+    pi = lax.bitcast_convert_type(pay, jnp.int32)
+    hi_f = lax.bitcast_convert_type(pi & jnp.int32(-65536), jnp.float32)
+    lo = (pay - hi_f).astype(jnp.bfloat16)
+    hi = hi_f.astype(jnp.bfloat16)     # exact: low bits already zero
+    return jnp.concatenate([hi, lo], axis=0)
+
+
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
-                 hslot_ref, rec_ref, out_ref, hist_ref, stag, fbuf,
-                 hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
+                 hslot_ref, cbits_ref, rec_ref, out_ref, hist_ref, stag,
+                 fbuf, hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
                  num_features, b_pad, group, dummy, bag_lane):
     """One grid step of the fused move+hist pass.
 
@@ -234,9 +269,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         hm = jnp.where(take, h, 0.0)
         cntp = take.astype(jnp.float32)
         pay = jnp.stack([gm, hm, cntp], axis=0)
-        p_hi = pay.astype(jnp.bfloat16)
-        p_lo = (pay - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        pay6 = jnp.concatenate([p_hi, p_lo], axis=0)
+        pay6 = _hi_lo6(pay)
         iota_b = lax.broadcasted_iota(jnp.int32, (b_pad, C), 0)
         ngroups = (num_features + group - 1) // group
         for gi in range(ngroups):
@@ -282,7 +315,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         for wj in range(1, wcnt):
             word = jnp.where(wsel == wj, rec[wj, :], word)
         binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
-        left = _goes_left(binv, r1, r2_ref[i], valid)
+        catw = _cat_word(cbits_ref, hs & 0xFFFFFF, binv)
+        left = _goes_left(binv, r1, r2_ref[i], valid, catw)
 
         # ranks via one triangular matmul (measured FASTER on the MXU
         # than log2(C) pltpu.roll prefix sums: 3.33 vs 3.82 ns/row)
@@ -393,8 +427,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
     "group", "bag_lane", "interpret"))
-def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
-              w_pad, wcnt, num_slots, num_features, b_pad, group,
+def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
+              chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
               bag_lane=-1, interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
@@ -428,18 +462,18 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=6,
         grid=(nc,),
         in_specs=[
             pl.BlockSpec((1, w_pad, chunk),
-                         lambda i, a, b, c, d, e: (i, 0, 0)),
+                         lambda i, a, b, c, d, e, f: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
             # constant index map: the compact hist store is resident in
             # VMEM for the whole pass and written back once at the end
             pl.BlockSpec((num_slots + 1, ngroups, 6, group * b_pad),
-                         lambda i, a, b, c, d, e: (0, 0, 0, 0)),
+                         lambda i, a, b, c, d, e, f: (0, 0, 0, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
@@ -460,7 +494,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 << 20, has_side_effects=True),
         interpret=interpret,
-    )(r1p, r2, blbr, meta, hslots, records)
+    )(r1p, r2, blbr, meta, hslots, cbits, records)
     hist = hist.reshape(num_slots + 1, ngroups, 6, group, b_pad)
     hist = hist[:, :, :3] + hist[:, :, 3:]
     hist = jnp.moveaxis(hist, 2, 4)
@@ -471,8 +505,8 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, chunk,
 # ---------------------------------------------------------------------------
 # physical left-count pass
 # ---------------------------------------------------------------------------
-def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, rec_ref,
-                  out_ref, cacc, *, chunk, dummy):
+def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
+                  rec_ref, out_ref, cacc, *, chunk, dummy):
     """Exact i32 count of PHYSICAL rows routed left per selected split.
 
     Streams only each block's split-word sublane (4 B/row). Needed when
@@ -504,7 +538,8 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, rec_ref,
         binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
         pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
         valid = pos < (meta & ((1 << 20) - 1))
-        left = _goes_left(binv, r1, r2_ref[i], valid)
+        catw = _cat_word(cbits_ref, ks_ref[i], binv)
+        left = _goes_left(binv, r1, r2_ref[i], valid, catw)
         cacc[0] = cacc[0] + jnp.sum(left.astype(jnp.int32))
 
         @pl.when(((meta >> 21) & 1) != 0)          # block's last chunk
@@ -514,8 +549,8 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, rec_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "chunk",
                                              "interpret"))
-def count_pass(records, r1, r2, meta, wsel, kslots, num_slots, chunk,
-               interpret=False):
+def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
+               chunk, interpret=False):
     """[num_slots] i32 physical left counts per compact slot id.
 
     kslots[i] = compact id of chunk i's selected split (num_slots =
@@ -526,10 +561,11 @@ def count_pass(records, r1, r2, meta, wsel, kslots, num_slots, chunk,
     kernel = functools.partial(_count_kernel, chunk=chunk,
                                dummy=num_slots)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=6,
         grid=(nc,),
         in_specs=[pl.BlockSpec((1, 8, chunk),
-                               lambda i, a, b, m, w, k: (i, w[i] >> 3, 0))],
+                               lambda i, a, b, m, w, k, cb:
+                               (i, w[i] >> 3, 0))],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         scratch_shapes=[pltpu.SMEM((8,), jnp.int32)],
     )
@@ -539,7 +575,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, num_slots, chunk,
         out_shape=jax.ShapeDtypeStruct((num_slots + 1,), jnp.int32),
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
         interpret=interpret,
-    )(r1, r2, meta, wsel, kslots, records)
+    )(r1, r2, meta, wsel, kslots, cbits, records)
     return out[:num_slots]
 
 
@@ -570,9 +606,7 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
         hm = jnp.where(valid, h, 0.0)
         cnt = valid.astype(jnp.float32)
         pay = jnp.stack([gm, hm, cnt], axis=0)
-        p_hi = pay.astype(jnp.bfloat16)
-        p_lo = (pay - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        pay6 = jnp.concatenate([p_hi, p_lo], axis=0)  # [6, C]
+        pay6 = _hi_lo6(pay)                           # [6, C]
 
         iota_b = lax.broadcasted_iota(jnp.int32, (b_pad, chunk), 0)
         ngroups = (num_features + group - 1) // group

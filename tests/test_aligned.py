@@ -128,3 +128,46 @@ def test_aligned_early_stop_tree_commits():
     g.materialized_models()
     assert g.models[0].num_leaves >= 2
     assert g.eval_train()[0][2] < 0.55
+
+
+def test_aligned_categorical_matches_leafwise():
+    """Round 4: categorical bitset routing on the aligned engine (the
+    compact per-round bitset table + R_CAT route bit)."""
+    rng = np.random.default_rng(9)
+    n = 3000
+    Xc = rng.integers(0, 12, n).astype(np.float32)
+    Xn = rng.standard_normal((n, 4)).astype(np.float32)
+    X = np.column_stack([Xc, Xn])
+    y = ((np.isin(Xc, [1, 3, 7]) * 1.0 + Xn[:, 0]
+          + 0.3 * rng.standard_normal(n)) > 0.5).astype(np.float32)
+    extra = {"categorical_feature": "0", "max_cat_to_onehot": 1,
+             "cat_smooth": 1.0, "min_data_per_group": 5}
+    a = _train(X, y, "aligned", iters=5, extra=extra)
+    assert a._gbdt._aligned_eligible()
+    b = _train(X, y, "leafwise", iters=5, extra=extra)
+    ta, tb = _tree_tuples(a), _tree_tuples(b)
+    assert len(ta) == len(tb)
+    for (fa, tha, va), (fb, thb, vb) in zip(ta, tb):
+        assert fa == fb
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-6)
+
+
+def test_aligned_categorical_bagging():
+    rng = np.random.default_rng(10)
+    n = 3000
+    Xc = rng.integers(0, 9, n).astype(np.float32)
+    Xn = rng.standard_normal((n, 4)).astype(np.float32)
+    X = np.column_stack([Xc, Xn])
+    # noisy labels: a pure threshold function degenerates the deep splits
+    # to zero-gain ties that f32 noise resolves arbitrarily
+    y = ((np.isin(Xc, [2, 5]) * 1.2 + Xn[:, 1]
+          + 0.4 * rng.standard_normal(n)) > 0.6).astype(np.float32)
+    extra = {"categorical_feature": "0", "max_cat_to_onehot": 1,
+             "bagging_fraction": 0.7, "bagging_freq": 1}
+    a = _train(X, y, "aligned", iters=5, extra=extra)
+    assert a._gbdt._aligned_eligible()
+    b = _train(X, y, "leafwise", iters=5, extra=extra)
+    ta, tb = _tree_tuples(a), _tree_tuples(b)
+    for (fa, tha, va), (fb, thb, vb) in zip(ta, tb):
+        assert fa == fb
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-6)

@@ -81,14 +81,15 @@ def main():
         baser = np.full(NC, nc_data // 2, np.int32)
 
         def mk_move(k, hsl, r1v, metav, blv, brv):
+            cb0 = jnp.zeros((S + 2) * 8, jnp.int32)
             a = tuple(jnp.asarray(x) for x in
                       (r1v, r2, blv, brv, metav, wsel, hsl))
 
             @jax.jit
             def f(r):
                 def body(i, r):
-                    r2_, _ = move_pass(r, *a, C, W, wcnt, S + 1, F, B,
-                                       group)
+                    r2_, _ = move_pass(r, *a, cb0, C, W, wcnt, S + 1, F,
+                                       B, group)
                     return r2_
                 return lax.fori_loop(0, k, body, r)
             return f

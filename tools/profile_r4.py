@@ -131,9 +131,10 @@ def main():
     wsel = np.zeros(NC, np.int32)
     hsl = np.zeros(NC, np.int32)   # accumulate slot 0, left side
     KB = 256                       # compact-store height (kernel contract)
+    cb0 = jnp.zeros((KB + 1) * 8, jnp.int32)
     args = [jnp.asarray(x) for x in (r1, r2, basel, baser, meta, wsel, hsl)]
     t_move_split = timeit(lambda: move_pass(
-        rec, *args, C, W, wcnt, KB, F, B, group))
+        rec, *args, cb0, C, W, wcnt, KB, F, B, group))
     print(f"move_all_split={t_move_split*1e3:.1f}ms "
           f"({t_move_split/N*1e9:.2f} ns/row)", flush=True)
 
@@ -143,7 +144,7 @@ def main():
     argsc = [jnp.asarray(x) for x in
              (r1c, r2, iota, iota, metac, wsel, np.full(NC, KB, np.int32))]
     t_move_copy = timeit(lambda: move_pass(
-        rec, *argsc, C, W, wcnt, KB, F, B, group))
+        rec, *argsc, cb0, C, W, wcnt, KB, F, B, group))
     print(f"move_all_copy={t_move_copy*1e3:.1f}ms "
           f"({t_move_copy/N*1e9:.2f} ns/row)", flush=True)
 

@@ -70,17 +70,18 @@ def main():
         a_wh = [jnp.asarray(x) for x in
                 (r1, r2, basel, baser, meta, wsel, withhist)]
         try:
-            t_nh, c1 = timeit(lambda: move_pass(rec, *a_nh, C, W, wcnt,
-                                                S + 1, F, B, group))
-            t_wh, c2 = timeit(lambda: move_pass(rec, *a_wh, C, W, wcnt,
-                                                S + 1, F, B, group))
+            cb0 = jnp.zeros((S + 2) * 8, jnp.int32)
+            t_nh, c1 = timeit(lambda: move_pass(rec, *a_nh, cb0, C, W,
+                                                wcnt, S + 1, F, B, group))
+            t_wh, c2 = timeit(lambda: move_pass(rec, *a_wh, cb0, C, W,
+                                                wcnt, S + 1, F, B, group))
             # all-copy
             r1c = np.full(NC, (1 << 16), np.int32)
             metac = (meta_cnt | (1 << 20) | (1 << 21)).astype(np.int32)
             a_cp = [jnp.asarray(x) for x in
                     (r1c, r2, iota, iota, metac, wsel, nohist)]
-            t_cp, c3 = timeit(lambda: move_pass(rec, *a_cp, C, W, wcnt,
-                                                S + 1, F, B, group))
+            t_cp, c3 = timeit(lambda: move_pass(rec, *a_cp, cb0, C, W,
+                                                wcnt, S + 1, F, B, group))
             print(f"C={C}: move_split_nohist={t_nh*1e3:.1f}ms "
                   f"({t_nh/N*1e9:.2f}ns) move_split_hist={t_wh*1e3:.1f}ms "
                   f"({t_wh/N*1e9:.2f}ns) copy={t_cp*1e3:.1f}ms "
