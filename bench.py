@@ -28,6 +28,7 @@ BENCH_SKIP_255=1.
 """
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -221,6 +222,113 @@ def run_mslr(n, f, iters, warmup):
     return per_iter * BASELINE_ITERS, nd
 
 
+def run_valid_overhead(X, y, hX, hy, leaves, iters, warmup):
+    """Per-iter cost WITH a valid set + per-iter AUC vs without (VERDICT
+    r3 #2: the device walker + device AUC must keep this <10%)."""
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "auc"}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    vs = lgb.Dataset(hX, label=hy, reference=ds, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.add_valid(vs, "v")
+    g = bst._gbdt
+    for _ in range(warmup):
+        bst.update()
+        g.eval_valid()
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(iters):
+        bst.update()
+        last = g.eval_valid()
+    per_iter = (time.perf_counter() - t0) / iters
+    log(f"# valid-attached per_iter={per_iter * 1e3:.1f}ms "
+        f"(auc={last[0][2]:.6f})")
+    return per_iter
+
+
+def _fmt_tsv(path, y, X, t0):
+    with open(path, "w") as fh:
+        blk = 100_000
+        for s in range(0, len(y), blk):
+            e = min(s + blk, len(y))
+            body = np.column_stack([y[s:e], X[s:e]])
+            fh.write("\n".join(
+                "\t".join(f"{v:.6g}" for v in row) for row in body))
+            fh.write("\n")
+    log(f"#   tsv write {path}: {time.perf_counter() - t0:.1f}s")
+
+
+def run_ref_parity(X, y, hX, hy, leaves):
+    """Side-by-side quality vs the ACTUAL reference binary on identical
+    1M-row data, 100 iterations, max_bin=63 (VERDICT r3 #7). Returns
+    (auc_ours, auc_ref) or (None, None) when the CLI can't be built."""
+    import subprocess
+    import tempfile
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    try:
+        from test_reference_parity import _ensure_cli, CLI
+    except Exception:
+        return None, None
+    if not _ensure_cli():
+        log("# ref parity: reference CLI unavailable")
+        return None, None
+    n1 = min(len(y), 1_000_000)
+    nh = min(len(hy), 100_000)
+    td = tempfile.mkdtemp(prefix="refpar_")
+    t0 = time.perf_counter()
+    train_p = os.path.join(td, "train.tsv")
+    hold_p = os.path.join(td, "hold.tsv")
+    _fmt_tsv(train_p, y[:n1], X[:n1], t0)
+    _fmt_tsv(hold_p, hy[:nh], hX[:nh], time.perf_counter())
+    conf = [
+        "task = train", "objective = binary", f"num_leaves = {leaves}",
+        "max_bin = 63", "learning_rate = 0.1", "min_data_in_leaf = 20",
+        "num_trees = 100", "verbosity = -1", "metric = auc",
+        f"data = {train_p}",
+        f"output_model = {os.path.join(td, 'ref.txt')}",
+    ]
+    cpath = os.path.join(td, "t.conf")
+    with open(cpath, "w") as fh:
+        fh.write("\n".join(conf))
+    try:
+        t0 = time.perf_counter()
+        subprocess.run([CLI, f"config={cpath}"], check=True,
+                       capture_output=True, timeout=1800)
+        log(f"#   ref train: {time.perf_counter() - t0:.1f}s")
+        pconf = [
+            "task = predict", f"data = {hold_p}",
+            f"input_model = {os.path.join(td, 'ref.txt')}",
+            f"output_result = {os.path.join(td, 'ref_pred.txt')}",
+        ]
+        with open(cpath, "w") as fh:
+            fh.write("\n".join(pconf))
+        subprocess.run([CLI, f"config={cpath}"], check=True,
+                       capture_output=True, timeout=600)
+        ref_pred = np.loadtxt(os.path.join(td, "ref_pred.txt"))
+        auc_ref = auc_of(ref_pred, hy[:nh])
+    except Exception as e:   # the bench's JSON line must still print
+        log(f"# ref parity FAILED: {type(e).__name__}: {e}")
+        shutil.rmtree(td, ignore_errors=True)
+        return None, None
+    # ours: same data, same config, on the TPU path
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none"}
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X[:n1], label=y[:n1], params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(100):
+        bst.update()
+    auc_ours = auc_of(bst.predict(hX[:nh]), hy[:nh])
+    log(f"#   ours train+predict: {time.perf_counter() - t0:.1f}s")
+    log(f"# ref parity (1M rows, 100 iters, 63-bin): "
+        f"ours={auc_ours:.6f} ref={auc_ref:.6f}")
+    shutil.rmtree(td, ignore_errors=True)
+    return auc_ours, auc_ref
+
+
 def main() -> None:
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_ROWS", 20_000 if smoke else 10_500_000))
@@ -250,6 +358,18 @@ def main() -> None:
         projected255, _ = run_higgs(n, f, leaves, max(iters // 2, 2),
                                     warmup, 255, None, None, X, y)
         out["value_255bin"] = round(projected255, 2)
+    if os.environ.get("BENCH_SKIP_VALID") != "1":
+        vo_iters = 3 if smoke else 10
+        per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
+                                       leaves, vo_iters, 2)
+        base_per = projected / BASELINE_ITERS
+        out["valid_overhead_pct"] = round(
+            (per_valid / base_per - 1.0) * 100.0, 1)
+    if os.environ.get("BENCH_SKIP_REF") != "1" and not smoke:
+        auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
+        if auc_ref is not None:
+            out["auc_ours_1m_100it"] = round(auc_ours_1m, 6)
+            out["auc_ref"] = round(auc_ref, 6)
     del X, y, Xall, yall
     if os.environ.get("BENCH_SKIP_RANK") != "1":
         nm = 30_000 if smoke else 2_270_000

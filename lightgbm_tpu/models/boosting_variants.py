@@ -69,9 +69,17 @@ class GOSS(GBDT):
 
 
 class DART(GBDT):
-    """Dropouts meet Multiple Additive Regression Trees (dart.hpp:25-209)."""
+    """Dropouts meet Multiple Additive Regression Trees (dart.hpp:25-209).
 
-    _fused_ok = False  # drop/renormalize mutates host trees mid-training
+    Round 4: trains on the FUSED device learner (whole-tree jitted
+    programs) like plain GBDT — the drop/renormalize machinery already
+    runs on device score arrays via binned traversal
+    (apply_tree_to_score); only the per-iteration tree materialization
+    (one small batched pull in _dropping_trees) touches the host. The
+    aligned engine stays out (its score lane cannot follow dropped
+    scores — get_training_score override gates it), so DART uses the
+    leaf-wise fused path (dart.hpp:58 shares the full-speed core the
+    same way)."""
 
     def __init__(self, cfg: Config, train_data: Dataset, objective=None):
         super().__init__(cfg, train_data, objective)
@@ -93,6 +101,20 @@ class DART(GBDT):
         ret = super().train_one_iter(grad, hess)
         if ret:
             return ret
+        # the fused path defers its empty-tree check (batched trim), but
+        # DART's tree_weight/sum_weight bookkeeping must stay aligned
+        # with self.models — resolve the just-trained tree NOW (DART
+        # pulls each iteration anyway for drop materialization) and stop
+        # at the first no-split iteration like the reference
+        if self._pending_numsplits \
+                and len(self.models) > self.num_tree_per_iteration:
+            ns = int(np.max(jax.device_get(
+                self._pending_numsplits[-self.num_tree_per_iteration:])))
+            if ns == 0:
+                del self.models[-self.num_tree_per_iteration:]
+                del self._pending_numsplits[-self.num_tree_per_iteration:]
+                self.iter -= 1
+                return True
         self._normalize()
         if not self.cfg.uniform_drop:
             self.tree_weight.append(self.shrinkage_rate)
@@ -102,6 +124,9 @@ class DART(GBDT):
     # ------------------------------------------------------------------
     def _dropping_trees(self) -> None:
         """dart.hpp:97-146."""
+        # the fused path appends LazyTree records; dropping needs host
+        # trees (leaf-value mutation + re-application)
+        self.materialized_models()
         cfg = self.cfg
         self.drop_index = []
         is_skip = self._drop_rng.rand() < cfg.skip_drop
@@ -129,14 +154,20 @@ class DART(GBDT):
                         self.drop_index.append(self.num_init_iteration + i)
                         if len(self.drop_index) >= cfg.max_drop > 0:
                             break
-        # subtract dropped trees from the training score (Shrinkage(-1) +
-        # AddScore)
+        # drop: NEGATE the stored tree (reference Shrinkage(-1),
+        # dart.hpp:137-143) then add — the stored sign matters because
+        # Normalize's two shrinkage steps continue FROM -1 and must end
+        # at +k/(k+1) (see the reference's step 1-3 note); applying the
+        # subtraction as a score-side scale instead left dropped trees'
+        # stored values negated after normalization (wrong exported
+        # model AND wrong renormalized scores)
         for i in self.drop_index:
             for k in range(self.num_tree_per_iteration):
                 t = self.models[i * self.num_tree_per_iteration + k]
                 if t.num_leaves > 1:
+                    t.apply_shrinkage(-1.0)
                     self.apply_tree_to_score(self.train_score,
-                                             self.train_data.bins, t, k, -1.0)
+                                             self.train_data.bins, t, k, 1.0)
         if not self.cfg.xgboost_dart_mode:
             self.shrinkage_rate = self.cfg.learning_rate \
                 / (1.0 + len(self.drop_index))
@@ -184,9 +215,12 @@ class DART(GBDT):
 
 class RF(GBDT):
     """Random forest mode (rf.hpp:25-194): mandatory bagging, no shrinkage,
-    one-time gradients from constant init scores, running-average output."""
+    one-time gradients from constant init scores, running-average output.
 
-    _fused_ok = False  # custom TrainOneIter drives the host learner directly
+    Round 4: trains on the FUSED device learner when eligible (renewal
+    objectives still use the host learner), mirroring rf.hpp:103 sharing
+    the full-speed core; the running-average score reshaping stays in
+    device score arrays (MultiplyScore + traversal)."""
 
     def __init__(self, cfg: Config, train_data: Dataset, objective=None):
         super().__init__(cfg, train_data, objective)
@@ -211,25 +245,37 @@ class RF(GBDT):
         g, h = self.objective.get_gradients(tmp)
         self._rf_grad, self._rf_hess = g, h
 
+    def _build_rf_tree(self, gdev, hdev, k):
+        """One RF tree: fused device learner (whole-tree jitted program,
+        one small pull) when eligible, host learner otherwise."""
+        if self.use_fused:
+            fmask = self.learner.feature_mask()
+            idxs, count = self.learner.init_root_partition(
+                self.bag_data_indices, self.bag_data_cnt)
+            idxs, rec = self.learner.train(gdev[k], hdev[k], idxs,
+                                           count, fmask)
+            return self.learner.record_to_tree(jax.device_get(rec), 1.0)
+        new_tree, leaf_map = self.learner.train(
+            gdev[k], hdev[k], self.bag_data_indices, self.bag_data_cnt)
+        if (new_tree.num_leaves > 1 and self.objective is not None
+                and getattr(self.objective, "is_renew_tree_output",
+                            False)):
+            pred = np.full(self.num_data, self.init_scores[k])
+            self.learner.renew_tree_output(
+                new_tree, leaf_map, self.objective, pred,
+                self._label_np, self._weight_np)
+        return new_tree
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """rf.hpp:103-166."""
         self._bagging(self.iter)
         gdev, hdev = self._rf_grad, self._rf_hess
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
-            leaf_map = {}
-            if self._class_need_train[k]:
-                new_tree, leaf_map = self.learner.train(
-                    gdev[k], hdev[k], self.bag_data_indices,
-                    self.bag_data_cnt)
+            if self._class_need_train[k] \
+                    and self.train_data.num_features > 0:
+                new_tree = self._build_rf_tree(gdev, hdev, k)
             if new_tree.num_leaves > 1:
-                if (self.objective is not None
-                        and getattr(self.objective, "is_renew_tree_output",
-                                    False)):
-                    pred = np.full(self.num_data, self.init_scores[k])
-                    self.learner.renew_tree_output(
-                        new_tree, leaf_map, self.objective, pred,
-                        self._label_np, self._weight_np)
                 if abs(self.init_scores[k]) > K_EPSILON:
                     new_tree.add_bias(self.init_scores[k])
                 # running average of tree outputs (rf.hpp:141-144)

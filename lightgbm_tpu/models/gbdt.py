@@ -129,7 +129,7 @@ class GBDT:
             return (lr._col_dev, lr._boff_dev, lr._bpk_dev)
         return None
 
-    _fused_ok = True  # DART/RF override: they reshape scores via host trees
+    _fused_ok = True  # subclass hook (no current subclass disables it)
 
     def __init__(self, cfg: Config, train_data: Dataset,
                  objective: Optional[ObjectiveFunction] = None) -> None:

@@ -163,3 +163,31 @@ def test_sklearn_custom_objective():
     m = lgb.LGBMRegressor(objective=l2_obj, n_estimators=30, num_leaves=15)
     m.fit(X, y)
     assert np.mean((m.predict(X) - y) ** 2) < 0.5
+
+
+def test_dart_model_predicts_consistently_with_scores():
+    """Regression (round 4): dropped trees must end normalization at
+    +k/(k+1) of their old weight — the reference NEGATES the stored tree
+    at drop time (dart.hpp:137-158, the 'shrink tree to -1' step) and
+    the two Normalize shrinkages continue from there. Applying the drop
+    as a score-side scale left exported models with negated dropped
+    trees: training curves looked fine while predict() was garbage."""
+    rng = np.random.default_rng(1)
+    n = 2500
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1]) > 0).astype(np.float32)
+    params = {"objective": "binary", "boosting": "dart",
+              "drop_rate": 0.2, "num_leaves": 15, "learning_rate": 0.1,
+              "verbosity": -1, "metric": "none"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, 12, keep_training_booster=True)
+    pred = bst.predict(X)
+    acc = float(np.mean((pred > 0.5) == (y > 0)))
+    assert acc > 0.9, acc
+    # exported model == training-score state
+    g = bst._gbdt
+    g._sync_train_score()
+    sc = g.train_score.numpy()[0]
+    raw = np.log(np.clip(pred, 1e-9, 1 - 1e-9)
+                 / np.clip(1 - pred, 1e-9, 1 - 1e-9))
+    assert np.corrcoef(sc, raw)[0, 1] > 0.999
