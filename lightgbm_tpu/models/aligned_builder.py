@@ -836,6 +836,39 @@ class AlignedEngine:
             return score + cover[jnp.clip(slot, 0, S)] * scale * gate
         return fn
 
+    def undo_spec_scores(self, spec, applied, scale):
+        """Subtract a dispatched-but-discarded iteration's (gated)
+        score-lane contribution — the exact valmap the build program
+        added, reconstructed from the spec's final leaf tables. Used
+        when an eagerly-dispatched next iteration is abandoned (training
+        stopped); restores the lane to metric-exactness."""
+        fn = self._program("undo", self._undo_program, donate=(0,))
+        self.rec = fn(self.rec, spec.leafI, spec.cover, spec.n_exec,
+                      applied, jnp.float32(scale))
+        self._score_cache = None
+        self._last_exact = jnp.asarray(True)
+
+    def _undo_program(self):
+        C, NC, S = self.C, self.NC, self.S
+        ln = self.lanes
+
+        def fn(rec, leafI, cover, n_exec, applied, scale):
+            begin = leafI[:, LI_BEGIN]
+            count = leafI[:, LI_COUNT]
+            chunk_iota = jnp.arange(NC, dtype=jnp.int32)
+            slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
+                              .astype(jnp.int32), axis=0) - 1
+            slot_of = jnp.clip(slot_of, 0, leafI.shape[0] - 1)
+            nch = (count + C - 1) // C
+            exists = jnp.arange(leafI.shape[0]) <= n_exec
+            in_any = ((chunk_iota >= begin[slot_of])
+                      & (chunk_iota < begin[slot_of] + nch[slot_of])
+                      & exists[slot_of] & (count[slot_of] > 0))
+            valmap = jnp.where(in_any & applied, cover[slot_of], 0.0)
+            sc = _f32(rec[:, ln["score"], :]) - valmap[:, None] * scale
+            return rec.at[:, ln["score"], :].set(_i32(sc))
+        return fn
+
     def set_bag(self, mask_rows):
         """Re-ingest a per-row 0/1 bagging mask into the bag lane (one
         streaming pass; called on bagging_freq boundaries)."""

@@ -637,28 +637,28 @@ class DeviceTreeLearner:
                 np.asarray(self.ds.bundles.group_num_bin))
             # histogram_pool_size (reference HistogramPool,
             # feature_histogram.hpp:654-829): the reference bounds the
-            # per-leaf histogram cache in MB with LRU + recompute; the
-            # TPU store is one [L, F, B, 3] array, so the budget is
-            # honored by dropping the store to bf16 (half memory; the
-            # subtract trick upcasts to f32). A budget below even the
-            # bf16 store warns.
+            # per-leaf histogram cache in MB with LRU + recompute. The
+            # TPU store is one [L, F, B, 3] array; the budget ladder is
+            # f32 store -> bf16 store (subtract upcasts to f32) ->
+            # RECOMPUTE mode (no per-leaf store at all: both children
+            # are histogrammed directly at each split, the analogue of
+            # an always-missing pool — up to ~2x histogram work, O(1)
+            # histogram memory).
             store_dtype = jnp.float32
+            pool_recompute = False
             pool_mb = float(cfg.histogram_pool_size)
             if pool_mb > 0:
                 f32_mb = L * ncols * BH * NUM_HIST_STATS * 4 / 2**20
                 if f32_mb > pool_mb:
                     store_dtype = jnp.bfloat16
                     if f32_mb / 2 > pool_mb:
-                        import warnings
-                        warnings.warn(
-                            "histogram_pool_size=%.0fMB < bf16 store "
-                            "(%.0fMB); the TPU build cannot go lower "
-                            "without per-leaf recompute" %
-                            (pool_mb, f32_mb / 2))
-            hist_store = jnp.zeros((L, ncols, BH, NUM_HIST_STATS),
+                        pool_recompute = True
+            store_L = 1 if pool_recompute else L
+            hist_store = jnp.zeros((store_L, ncols, BH, NUM_HIST_STATS),
                                    store_dtype)
-            hist_store = hist_store.at[0].set(
-                root_hist.astype(store_dtype))
+            if not pool_recompute:
+                hist_store = hist_store.at[0].set(
+                    root_hist.astype(store_dtype))
             leafF = jnp.zeros((L, LF_W), jnp.float32)
             leafF = leafF.at[:, LF_MINC].set(-jnp.inf)
             leafF = leafF.at[:, LF_MAXC].set(jnp.inf)
@@ -787,13 +787,28 @@ class DeviceTreeLearner:
                 sm_hist = lax.switch(bk2, hist_fns, bins, new_indices,
                                      gh, sm_begin, sm_count)
                 sm_hist = _gsum_hist(sm_hist)
-                lg_hist = hist_store[bl].astype(jnp.float32) - sm_hist
+                if pool_recompute:
+                    # pool budget below the bf16 store: no per-leaf
+                    # cache — histogram the larger child directly too
+                    # (the reference's pool-miss recompute path)
+                    lg_begin = jnp.where(smaller_is_left,
+                                         begin + left_cnt, begin)
+                    lg_count = jnp.where(smaller_is_left, right_cnt,
+                                         left_cnt)
+                    bk3 = self._bucket_index(lg_count, buckets)
+                    lg_hist = lax.switch(bk3, hist_fns, bins,
+                                         new_indices, gh, lg_begin,
+                                         lg_count)
+                    lg_hist = _gsum_hist(lg_hist)
+                else:
+                    lg_hist = hist_store[bl].astype(jnp.float32) - sm_hist
                 left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
                 right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
-                hist_store = hist_store.at[bl].set(
-                    left_hist.astype(hist_store.dtype))
-                hist_store = hist_store.at[new_leaf].set(
-                    right_hist.astype(hist_store.dtype))
+                if not pool_recompute:
+                    hist_store = hist_store.at[bl].set(
+                        left_hist.astype(hist_store.dtype))
+                    hist_store = hist_store.at[new_leaf].set(
+                        right_hist.astype(hist_store.dtype))
 
                 # evaluate both children (global counts)
                 lF, lI, lB = eval_leaf(left_hist, bF[BF_LG], bF[BF_LH],

@@ -444,20 +444,17 @@ class GBDT:
                 init_row_scores=np.asarray(self.train_score.score[0]),
                 bagged=self._will_bag())
             self._aligned_eng_ref = eng
-        if self._will_bag() and self.iter % cfg.bagging_freq == 0:
-            # resample on bagging_freq boundaries and re-ingest the 0/1
-            # mask into the bag lane (gbdt.cpp:209-275; the engine's
-            # histograms and gradients honor it, the physical layout
-            # keeps ALL rows so out-of-bag rows still get scores)
-            self._bagging(self.iter)
-            mask = np.zeros(self.num_data, np.float32)
-            if self.bag_data_indices is not None:
-                mask[self.bag_data_indices] = 1.0
-            else:
-                mask[:] = 1.0
-            eng.set_bag(mask)
-        fmask = self.learner.feature_mask()
-        out = self._dispatch_aligned(eng, fmask)
+        stash = getattr(self, "_aligned_next", None)
+        if stash is not None:
+            # this iteration was dispatched EAGERLY at the end of the
+            # previous call (before its blocking metric eval), keeping
+            # the device busy through per-iteration valid evals
+            self._aligned_next = None
+            out, fmask, _rng_snap = stash
+        else:
+            self._maybe_rebag(eng)
+            fmask = self.learner.feature_mask()
+            out = self._dispatch_aligned(eng, fmask)
         # resolve the PREVIOUS iteration while this one runs on device
         redo = self._resolve_aligned_pending(final=False)
         if redo is not None:
@@ -492,12 +489,84 @@ class GBDT:
             su.score = su.score.at[0].set(eng.apply_spec_to_scores(
                 su.score[0], self._valid_bins_dev[i], spec, applied_dev,
                 self.shrinkage_rate))
+        if self.valid_scores:
+            # queue the device metric programs for THIS iteration before
+            # the eager next build: the device executes in queue order,
+            # so eval scalars resolve right after the walks instead of
+            # behind the whole next build
+            stash = []
+            for su, ms in zip(self.valid_scores, self.valid_metrics):
+                stash.append([m.eval_dev(su.score, self.objective)
+                              for m in ms])
+            self._valid_eval_stash = stash
+            # train metrics likewise (valid_sets often include the train
+            # set): queue device scalars over the materialized score
+            # lane so per-iteration train eval doesn't have to discard
+            # the eager dispatch
+            self._train_eval_stash = None
+            if self.train_metrics and all(
+                    type(m).eval_dev is not Metric.eval_dev
+                    for m in self.train_metrics):
+                view = eng.row_scores_dev()[None, :]
+                self._train_eval_stash = [
+                    m.eval_dev(view, self.objective)
+                    for m in self.train_metrics]
+            # per-iteration eval is about to BLOCK on this iteration's
+            # completion; dispatch the next build now so the device never
+            # idles (if training stops instead, _discard_eager undoes the
+            # speculative tree's score-lane contribution AND restores the
+            # column/bag sampling RNG state its preparation consumed)
+            rng_snap = (self.learner._feat_rng.get_state()
+                        if hasattr(self.learner, "_feat_rng") else None,
+                        self._bag_rng.get_state(),
+                        self.bag_data_indices, self.bag_data_cnt)
+            self._maybe_rebag(eng)
+            fmask_n = self.learner.feature_mask()
+            self._aligned_next = (self._dispatch_aligned(eng, fmask_n),
+                                  fmask_n, rng_snap)
         if len(self._pending_numsplits) >= 16 * self.num_tree_per_iteration:
             res = self._resolve_aligned_pending(final=True)
             if res is not None and res[1]:
                 return True
             return self._trim_trailing_empty()
         return False
+
+    def _maybe_rebag(self, eng) -> None:
+        """Resample on bagging_freq boundaries and re-ingest the 0/1 mask
+        into the bag lane (gbdt.cpp:209-275; the engine's histograms and
+        gradients honor it, the physical layout keeps ALL rows so
+        out-of-bag rows still get scores)."""
+        cfg = self.cfg
+        if not (self._will_bag() and self.iter % cfg.bagging_freq == 0):
+            return
+        self._bagging(self.iter)
+        mask = np.zeros(self.num_data, np.float32)
+        if self.bag_data_indices is not None:
+            mask[self.bag_data_indices] = 1.0
+        else:
+            mask[:] = 1.0
+        eng.set_bag(mask)
+
+    def _discard_eager(self) -> None:
+        """Drop a speculatively-dispatched next iteration: undo its
+        (gated) score-lane contribution so the engine lane is
+        authoritative again. f32 add-then-subtract restore is exact to
+        metric tolerance; nothing else of the dispatch is visible."""
+        stash = getattr(self, "_aligned_next", None)
+        if stash is None:
+            return
+        self._aligned_next = None
+        (spec, _nc, _ex, applied_dev), _fmask, rng_snap = stash
+        eng = self._aligned_eng_ref
+        eng.undo_spec_scores(spec, applied_dev, self.shrinkage_rate)
+        # rewind the sampling state the eager preparation consumed so a
+        # later re-dispatch draws the same mask/bag as a non-eager run
+        feat_state, bag_state, bag_idx, bag_cnt = rng_snap
+        if feat_state is not None:
+            self.learner._feat_rng.set_state(feat_state)
+        self._bag_rng.set_state(bag_state)
+        self.bag_data_indices = bag_idx
+        self.bag_data_cnt = bag_cnt
 
     def _dispatch_aligned(self, eng, fmask):
         grads = None
@@ -544,6 +613,9 @@ class GBDT:
         builder's fallback). `bag_idx`/`bag_cnt` = the bag draw the
         failed device build trained on."""
         cfg = self.cfg
+        # any stashed metric scalars were computed on pre-fallback scores
+        self._valid_eval_stash = None
+        self._train_eval_stash = None
         self._sync_train_score()
         gdev, hdev = self._gradients()
         bagged = self._will_bag() and bag_idx is not None
@@ -580,6 +652,7 @@ class GBDT:
     def _sync_train_score(self) -> None:
         """Materialize row-order training scores from the aligned engine
         (lazy: only metrics / renewal / rollback need them)."""
+        self._discard_eager()
         self._resolve_aligned_pending(final=True)
         if getattr(self, "_train_score_stale", False):
             eng = getattr(self, "_aligned_eng_ref", None)
@@ -591,6 +664,7 @@ class GBDT:
     def _drop_aligned(self) -> None:
         """Leave aligned mode permanently (rollback and other mutations
         the permuted engine state cannot follow)."""
+        self._discard_eager()
         self._resolve_aligned_pending(final=True)
         self._sync_train_score()
         self._aligned_disabled = True
@@ -788,9 +862,22 @@ class GBDT:
         # metric supports it — the permuted->row materialization stays on
         # device instead of bouncing [N] f32 through the host
         eng = getattr(self, "_aligned_eng_ref", None)
+        stash = getattr(self, "_train_eval_stash", None)
+        if eng is not None and stash is not None:
+            self._resolve_aligned_pending(final=True)
+            st = getattr(self, "_train_eval_stash", None)
+            if st is not None:      # no fallback invalidated it
+                self._train_eval_stash = None
+                out = []
+                for m, dev in zip(self.train_metrics, st):
+                    for mname, val in dev:
+                        out.append(("training", mname, float(val),
+                                    m.bigger_is_better))
+                return out
         if (eng is not None and self.train_metrics
                 and all(type(m).eval_dev is not Metric.eval_dev
                         for m in self.train_metrics)):
+            self._discard_eager()
             self._resolve_aligned_pending(final=True)
             if getattr(self, "_train_score_stale", False):
                 view = _DeviceScoreView(eng.row_scores_dev()[None, :])
@@ -802,11 +889,30 @@ class GBDT:
         # an inexact pending aligned iteration contributed 0 to the valid
         # scores (applied gate): resolve it NOW so the exact fallback tree
         # is applied before its metrics are recorded
-        self._resolve_aligned_pending(final=True)
+        fell_back = self._resolve_aligned_pending(final=True) is not None
+        stash = getattr(self, "_valid_eval_stash", None)
+        self._valid_eval_stash = None
         out = []
         for i, (su, ms) in enumerate(zip(self.valid_scores,
                                          self.valid_metrics)):
-            out.extend(self._eval(su, ms, f"valid_{i}"))
+            name = f"valid_{i}"
+            if stash is not None and not fell_back:
+                # pre-queued device scalars (resolve ahead of the eager
+                # next build in the device queue); host-only metrics
+                # still evaluate here
+                scores = None
+                if any(d is None for d in stash[i]):
+                    scores = su.numpy()
+                for m, dev in zip(ms, stash[i]):
+                    pairs = (dev if dev is not None
+                             else m.eval(scores, self.objective))
+                    for mname, val in pairs:
+                        out.append((name, mname, float(val),
+                                    m.bigger_is_better))
+            else:
+                # fallback replaced the tree (stashed scalars were
+                # computed on pre-fallback scores) — evaluate fresh
+                out.extend(self._eval(su, ms, name))
         return out
 
     def _eval(self, su, metrics: List[Metric],

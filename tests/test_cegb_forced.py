@@ -115,3 +115,32 @@ def test_histogram_pool_budget_changes_store():
         bst2.update()
     p2 = bst2.predict(X[:200])
     assert np.abs(p - p2).mean() < 0.05
+
+
+def test_histogram_pool_tiny_budget_recompute():
+    """Round 4 (VERDICT r3 #8): a histogram_pool_size below even the
+    bf16 store switches the fused learner to per-leaf RECOMPUTE (both
+    children histogrammed directly, no store) instead of warning —
+    identical trees, O(1) histogram memory."""
+    import warnings as _w
+    rng = np.random.default_rng(4)
+    n = 2500
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+            "learning_rate": 0.1, "verbosity": -1, "metric": "none",
+            "tpu_grow_mode": "leafwise"}
+    tiny = dict(base, histogram_pool_size=0.001)  # << bf16 store
+    with _w.catch_warnings():
+        _w.simplefilter("error")      # the old path warned; must not now
+        ds = lgb.Dataset(X, label=y, params=tiny).construct()
+        bt = lgb.Booster(params=tiny, train_set=ds)
+        for _ in range(3):
+            bt.update()
+    ds2 = lgb.Dataset(X, label=y, params=base).construct()
+    bf = lgb.Booster(params=base, train_set=ds2)
+    for _ in range(3):
+        bf.update()
+    pa = bt.predict(X[:400])
+    pb = bf.predict(X[:400])
+    np.testing.assert_allclose(pa, pb, rtol=1e-4, atol=1e-5)

@@ -114,3 +114,42 @@ def test_valid_with_early_stopping_aligned():
     bst = lgb.train(params, ds, 40, valid_sets=[vs], valid_names=["v"],
                     early_stopping_rounds=5, verbose_eval=False)
     assert bst.best_iteration >= 1
+
+
+def test_eager_discard_restores_state_and_determinism():
+    """An eagerly-dispatched next iteration that gets discarded
+    (mid-training sync) must leave NO trace: undo_spec_scores restores
+    the score lane and the column/bag sampling RNGs rewind, so training
+    continues bit-identically to a run that never synced."""
+    X, y = _make(3000)
+    Xv, yv = _make(1000, seed=2)
+    params = {"objective": "binary", "num_leaves": 8, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "auc",
+              "tpu_grow_mode": "aligned", "tpu_aligned_interpret": True,
+              "tpu_chunk": 256, "feature_fraction": 0.7,
+              "bagging_fraction": 0.8, "bagging_freq": 1}
+
+    def run(interrupt):
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        vs = lgb.Dataset(Xv, label=yv, reference=ds,
+                         params=params).construct()
+        bst = lgb.Booster(params=params, train_set=ds)
+        bst.add_valid(vs, "v")
+        g = bst._gbdt
+        for i in range(6):
+            bst.update()
+            g.eval_valid()
+            if interrupt and i == 3:
+                g._sync_train_score()   # discards the eager dispatch
+        g.materialized_models()
+        return [(list(t.split_feature_inner[:t.num_leaves - 1]),
+                 np.asarray(t.leaf_value[:t.num_leaves]))
+                for t in g.models]
+
+    a = run(False)
+    b = run(True)
+    assert len(a) == len(b)
+    for (fa, va), (fb, vb) in zip(a, b):
+        assert fa == fb
+        np.testing.assert_allclose(va, vb, rtol=1e-5, atol=1e-6)
