@@ -71,6 +71,26 @@ class AlignedSpec(NamedTuple):
     cover: jax.Array       # f32[S+1]
 
 
+def slot_in_any_map(begin, count, nc, chunk):
+    """(slot_of [nc], in_any [nc]) from monotonic block begins — the
+    layout-to-chunk mapping shared by the build program's chunk_maps and
+    undo_spec_scores (they must agree bit-for-bit: the undo subtracts
+    exactly the valmap the build added). Begins are an exclusive cumsum
+    over slot ids, so the containing slot is the LAST slot with
+    begin <= c (zero-width slots share a begin and lose the tie); the
+    O(S*nc) broadcast count vectorizes on the VPU where searchsorted
+    would serialize."""
+    chunk_iota = jnp.arange(nc, dtype=jnp.int32)
+    slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
+                      .astype(jnp.int32), axis=0) - 1
+    slot_of = jnp.clip(slot_of, 0, begin.shape[0] - 1)
+    nch = (count + chunk - 1) // chunk
+    in_range = ((chunk_iota >= begin[slot_of])
+                & (chunk_iota < begin[slot_of] + nch[slot_of])
+                & (count[slot_of] > 0))
+    return slot_of, in_range
+
+
 def _f32(x):
     return lax.bitcast_convert_type(x, jnp.float32)
 
@@ -309,17 +329,10 @@ class AlignedEngine:
             if root_span is not None:
                 is_root = jnp.arange(S + 1) == 0
                 nch = jnp.where(root_span & is_root, NC, nch)
-            # Layout ranges are assigned by an exclusive cumsum over slot
-            # ids, so begins are MONOTONIC in slot id: the containing slot
-            # of chunk c is the last slot with begin <= c (zero-width
-            # slots share their begin with the next wide one and lose the
-            # tie). The O(S*NC) broadcast count VECTORIZES on the VPU
-            # (searchsorted lowers to a serial while-loop of gathers —
-            # measured ~1.1 ms per call at NC=22k vs ~0.1 ms for the
-            # broadcast at S=766).
-            slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
-                              .astype(jnp.int32), axis=0) - 1
-            slot_of = jnp.clip(slot_of, 0, S)
+            # slot/in-range mapping shared with undo_spec_scores (see
+            # slot_in_any_map); nch here may carry the root_span
+            # override, so the range check stays local
+            slot_of, _ = slot_in_any_map(begin, count, NC, C)
             end_of = begin[slot_of] + nch[slot_of]
             in_any = ((chunk_iota >= begin[slot_of])
                       & (chunk_iota < end_of)
@@ -855,15 +868,9 @@ class AlignedEngine:
         def fn(rec, leafI, cover, n_exec, applied, scale):
             begin = leafI[:, LI_BEGIN]
             count = leafI[:, LI_COUNT]
-            chunk_iota = jnp.arange(NC, dtype=jnp.int32)
-            slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
-                              .astype(jnp.int32), axis=0) - 1
-            slot_of = jnp.clip(slot_of, 0, leafI.shape[0] - 1)
-            nch = (count + C - 1) // C
+            slot_of, in_range = slot_in_any_map(begin, count, NC, C)
             exists = jnp.arange(leafI.shape[0]) <= n_exec
-            in_any = ((chunk_iota >= begin[slot_of])
-                      & (chunk_iota < begin[slot_of] + nch[slot_of])
-                      & exists[slot_of] & (count[slot_of] > 0))
+            in_any = in_range & exists[slot_of]
             valmap = jnp.where(in_any & applied, cover[slot_of], 0.0)
             sc = _f32(rec[:, ln["score"], :]) - valmap[:, None] * scale
             return rec.at[:, ln["score"], :].set(_i32(sc))

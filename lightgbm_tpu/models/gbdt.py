@@ -219,6 +219,7 @@ class GBDT:
     def add_valid_dataset(self, ds: Dataset,
                           metrics: Optional[List[Metric]] = None) -> None:
         """reference GBDT::AddValidDataset (gbdt.cpp:119-147)."""
+        self._valid_eval_stash = None   # stash indexed by old set count
         self.valid_sets.append(ds)
         su = _ScoreUpdater(ds.num_data, self.num_tree_per_iteration,
                            self._reshape_init_score(ds))
@@ -502,11 +503,14 @@ class GBDT:
             # train metrics likewise (valid_sets often include the train
             # set): queue device scalars over the materialized score
             # lane so per-iteration train eval doesn't have to discard
-            # the eager dispatch
+            # the eager dispatch. Gated on eval_train having actually
+            # been called (otherwise every iteration would pay a wasted
+            # full-N materialization + metric program)
             self._train_eval_stash = None
-            if self.train_metrics and all(
-                    type(m).eval_dev is not Metric.eval_dev
-                    for m in self.train_metrics):
+            if (getattr(self, "_train_eval_wanted", False)
+                    and self.train_metrics and all(
+                        type(m).eval_dev is not Metric.eval_dev
+                        for m in self.train_metrics)):
                 view = eng.row_scores_dev()[None, :]
                 self._train_eval_stash = [
                     m.eval_dev(view, self.objective)
@@ -858,6 +862,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        self._train_eval_wanted = True
         # aligned engine: evaluate from a DEVICE score view when every
         # metric supports it — the permuted->row materialization stays on
         # device instead of bouncing [N] f32 through the host
