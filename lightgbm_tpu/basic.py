@@ -175,6 +175,37 @@ class Dataset:
                 self._handle.metadata.set_group(self.group)
             return self
         cfg = Config.from_params(self.params)
+        if isinstance(self.data, str):
+            # file-path construction (reference basic.py: Dataset accepts
+            # a path; two_round=True in params streams it in O(chunk)
+            # host memory through the loader's push-rows flow). The
+            # constructor's categorical_feature argument folds into the
+            # config spec the loader reads (the reference folds it into
+            # params the same way for file inputs).
+            if self.categorical_feature not in ("auto", None):
+                cats = list(self.categorical_feature)
+                if any(isinstance(c, str) for c in cats):
+                    cfg.categorical_feature = "name:" + ",".join(
+                        str(c) for c in cats)
+                else:
+                    cfg.categorical_feature = ",".join(
+                        str(int(c)) for c in cats)
+            from .io.loader import DatasetLoader
+            loader = DatasetLoader(cfg)
+            if ref is not None:
+                self._handle = loader.\
+                    load_from_file_align_with_other_dataset(self.data, ref)
+            else:
+                self._handle = loader.load_from_file(self.data)
+            if self.label is not None:
+                self._handle.metadata.set_label(self.label)
+            if self.weight is not None:
+                self._handle.metadata.set_weight(self.weight)
+            if self.group is not None:
+                self._handle.metadata.set_group(self.group)
+            if self.init_score is not None:
+                self._handle.metadata.set_init_score(self.init_score)
+            return self
         feature_names = (None if self.feature_name in ("auto", None)
                          else list(self.feature_name))
         raw_cats = (None if self.categorical_feature in ("auto", None)

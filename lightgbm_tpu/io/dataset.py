@@ -390,6 +390,140 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
+    @classmethod
+    def create_from_sample(cls, sample: np.ndarray, n_total: int,
+                           config: Optional[Config] = None,
+                           feature_names: Optional[List[str]] = None,
+                           categorical_feature: Optional[Sequence[int]]
+                           = None,
+                           reference: Optional["Dataset"] = None
+                           ) -> "Dataset":
+        """Streaming creation, step 1 of 3 (the reference's push-rows
+        flow: `LGBM_DatasetCreateFromSampledColumn` + `PushRows`,
+        c_api.h:52-256): bin mappers are found from a row SAMPLE, the
+        binned matrix is preallocated for ``n_total`` rows, and callers
+        fill it incrementally with :meth:`push_rows` before sealing the
+        dataset with :meth:`finish_load`. Peak host memory is the sample
+        plus the uint8 binned matrix — the full float matrix never
+        exists.
+
+        With ``reference`` the sample may be None: mappers are shared so
+        a streamed validation set aligns with the training set.
+        """
+        cfg = config or Config()
+        self = cls()
+        self.num_data = int(n_total)
+        self.metadata = Metadata(self.num_data)
+        self.max_bin = cfg.max_bin
+        self.min_data_in_bin = cfg.min_data_in_bin
+        self.use_missing = cfg.use_missing
+        self.zero_as_missing = cfg.zero_as_missing
+
+        if reference is not None:
+            f = reference.num_total_features
+            self.num_total_features = f
+            self.mappers = reference.mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_feature_idx = reference.real_feature_idx
+            self.max_bin = reference.max_bin
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+            self.feature_names = reference.feature_names
+        else:
+            sample = np.asarray(sample, np.float64)
+            f = sample.shape[1]
+            self.num_total_features = f
+            self.feature_names = (list(feature_names) if feature_names
+                                  else [f"Column_{i}" for i in range(f)])
+            cat_set = _cat_set_from(cfg, categorical_feature)
+            self.mappers = []
+            for j in range(f):
+                col = sample[:, j]
+                nonzero = col[~((col >= -1e-35) & (col <= 1e-35))]
+                m = BinMapper()
+                bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+                m.find_bin(nonzero, total_sample_cnt=len(col),
+                           max_bin=cfg.max_bin,
+                           min_data_in_bin=cfg.min_data_in_bin,
+                           min_split_data=cfg.min_data_in_leaf,
+                           bin_type=bt, use_missing=cfg.use_missing,
+                           zero_as_missing=cfg.zero_as_missing)
+                self.mappers.append(m)
+            _finalize_used_features(self, cfg, f)
+
+        used = self.real_feature_idx
+        max_nb = max((self.mappers[j].num_bin for j in used), default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        self.bins = np.zeros((self.num_data, len(used)), dtype=dtype)
+        self._push_cfg = cfg
+        self._push_ref = reference
+        self._push_pos = 0
+        self._push_label = None
+        self._push_weight = None
+        self._push_init = None
+        return self
+
+    def push_rows(self, data: np.ndarray, label=None, weight=None,
+                  init_score=None) -> None:
+        """Streaming creation, step 2: bin one chunk of raw rows into the
+        preallocated matrix (reference `Dataset::PushOneRow` via
+        `LGBM_DatasetPushRows`, c_api.h:199-226). Chunks arrive in row
+        order; per-chunk label/weight/init_score slices ride along."""
+        if getattr(self, "_push_pos", None) is None:
+            raise RuntimeError(
+                "push_rows requires a dataset made by create_from_sample")
+        data = np.asarray(data)
+        if data.dtype not in (np.float32, np.float64):
+            data = data.astype(np.float64)
+        k = data.shape[0]
+        pos = self._push_pos
+        if pos + k > self.num_data:
+            raise ValueError(
+                f"push_rows overflow: {pos + k} > n_total={self.num_data}")
+        used = self.real_feature_idx
+        dtype = self.bins.dtype
+        chunk = self._native_bin_matrix(data, used, dtype)
+        if chunk is None:
+            chunk = np.empty((k, len(used)), dtype=dtype)
+            for col_idx, j in enumerate(used):
+                chunk[:, col_idx] = self.mappers[j].values_to_bins(
+                    np.asarray(data[:, j], np.float64)).astype(dtype)
+        self.bins[pos:pos + k] = chunk
+        if label is not None:
+            if self._push_label is None:
+                self._push_label = np.zeros(self.num_data, np.float64)
+            self._push_label[pos:pos + k] = np.asarray(label, np.float64)
+        if weight is not None:
+            if self._push_weight is None:
+                self._push_weight = np.ones(self.num_data, np.float64)
+            self._push_weight[pos:pos + k] = np.asarray(weight, np.float64)
+        if init_score is not None:
+            if self._push_init is None:
+                self._push_init = np.zeros(self.num_data, np.float64)
+            self._push_init[pos:pos + k] = np.asarray(init_score,
+                                                      np.float64)
+        self._push_pos = pos + k
+
+    def finish_load(self, group=None) -> "Dataset":
+        """Streaming creation, step 3: seal the dataset (reference
+        `Dataset::FinishLoad`, dataset.cpp:330): check the declared row
+        count, attach metadata, and apply feature bundling."""
+        pos = self._push_pos
+        if pos != self.num_data:
+            raise ValueError(
+                f"finish_load: {pos} rows pushed, {self.num_data} declared")
+        if self._push_label is not None:
+            self.metadata.set_label(self._push_label)
+        self.metadata.set_weight(self._push_weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(self._push_init)
+        self._maybe_bundle(self._push_cfg, self._push_ref)
+        self._push_cfg = self._push_ref = None
+        self._push_pos = None
+        self._push_label = self._push_weight = self._push_init = None
+        return self
+
+    # ------------------------------------------------------------------
     def _maybe_bundle(self, cfg, reference) -> None:
         """Exclusive Feature Bundling (reference dataset.cpp:68-213): the
         binned matrix shrinks to one storage column per bundle; the

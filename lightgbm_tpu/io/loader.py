@@ -16,7 +16,12 @@ import numpy as np
 
 from ..config import Config
 from .dataset import Dataset
-from .parser import create_parser, parse_dense
+from .parser import LibSVMParser, create_parser, parse_dense
+
+# rows per streamed chunk for two_round loading (the reference's
+# TextReader block size analogue, dataset_loader.cpp:162-266); test hook +
+# env override LGBM_TPU_INGEST_CHUNK
+DEFAULT_CHUNK_LINES = 1 << 16
 
 
 def _parse_column_spec(spec: str, names: Optional[List[str]]) -> List[int]:
@@ -36,6 +41,15 @@ def _parse_column_spec(spec: str, names: Optional[List[str]]) -> List[int]:
             out.append(names.index(w))
         return out
     return [int(s) for s in spec.split(",") if s.strip()]
+
+
+def _split_header_line(header_line: str) -> List[str]:
+    """Column names from a header line (tab/comma/space sniff — one
+    shared implementation for the one-shot and two_round paths)."""
+    for sep in ("\t", ",", " "):
+        if sep in header_line:
+            return [s.strip() for s in header_line.split(sep)]
+    return [header_line.strip()]
 
 
 def _read_sidecar(path: str) -> Optional[np.ndarray]:
@@ -112,14 +126,7 @@ class DatasetLoader:
         if labels is None:
             header_line, lines = self._read_text(filename)
             if header_line is not None:
-                sep_guess = None
-                for sep in ("\t", ",", " "):
-                    if sep in header_line:
-                        sep_guess = sep
-                        break
-                all_names = ([s.strip()
-                              for s in header_line.split(sep_guess)]
-                             if sep_guess else [header_line.strip()])
+                all_names = _split_header_line(header_line)
             label_idx = self._resolve_label_idx(all_names)
             parser = create_parser(lines[:32], label_idx)
             labels, feats = parse_dense(lines, parser)
@@ -184,18 +191,14 @@ class DatasetLoader:
         round-robin across ranks (reference random / in-order partition,
         `dataset_loader.cpp:606-650`)."""
         cfg = self.config
-        if getattr(cfg, "two_round", False):
-            import warnings
-            warnings.warn(
-                "two_round loading is not implemented on the TPU build "
-                "(datasets are binned in one pass; use "
-                "bin_construct_sample_cnt to bound sampling memory)",
-                stacklevel=2)
         if cfg.save_binary or filename.endswith(".bin"):
             binpath = filename if filename.endswith(".bin") \
                 else filename + ".bin"
             if os.path.isfile(binpath) and not cfg.save_binary:
                 return Dataset.load_binary(binpath)
+        if getattr(cfg, "two_round", False):
+            return self._load_two_round(filename, rank=rank,
+                                        num_machines=num_machines)
         labels, feats, ex = self.parse_file(filename)
         if num_machines > 1 and not cfg.pre_partition:
             sel = np.arange(len(labels)) % num_machines == rank
@@ -219,10 +222,206 @@ class DatasetLoader:
             ds.save_binary(filename + ".bin")
         return ds
 
+    # ------------------------------------------------------------------
+    def _iter_line_chunks(self, filename: str, chunk_lines: int):
+        """Yield lists of <= chunk_lines non-empty lines (header skipped);
+        peak host memory per chunk is O(chunk_lines)."""
+        with open(filename, errors="replace") as f:
+            if self.config.header:
+                f.readline()
+            buf: List[str] = []
+            for ln in f:
+                if not ln.strip():
+                    continue
+                buf.append(ln)
+                if len(buf) >= chunk_lines:
+                    self._max_chunk_rows = max(
+                        getattr(self, "_max_chunk_rows", 0), len(buf))
+                    yield buf
+                    buf = []
+            if buf:
+                self._max_chunk_rows = max(
+                    getattr(self, "_max_chunk_rows", 0), len(buf))
+                yield buf
+
+    def _header_names(self, filename: str) -> Optional[List[str]]:
+        if not self.config.header:
+            return None
+        with open(filename, errors="replace") as f:
+            header_line = f.readline().rstrip("\r\n")
+        return _split_header_line(header_line)
+
+    def _load_two_round(self, filename: str, rank: int = 0,
+                        num_machines: int = 1,
+                        reference: Optional[Dataset] = None,
+                        chunk_lines: Optional[int] = None) -> Dataset:
+        """Two-pass streaming load (reference two_round,
+        `dataset_loader.cpp:162-266` + `TextReader::SampleAndFilter`):
+
+        pass 1 streams the file in O(chunk) host memory, reservoir-
+        sampling up to ``bin_construct_sample_cnt`` rows for bin finding
+        and collecting the per-row metadata columns; pass 2 streams
+        again, binning each chunk straight into the preallocated uint8
+        matrix via the push-rows flow (`Dataset.create_from_sample` /
+        `push_rows` / `finish_load`). The full float matrix never exists
+        in host memory.
+        """
+        cfg = self.config
+        if chunk_lines is None:
+            chunk_lines = int(os.environ.get("LGBM_TPU_INGEST_CHUNK",
+                                             DEFAULT_CHUNK_LINES))
+        if not os.path.isfile(filename):
+            raise FileNotFoundError(f"data file {filename} not found")
+        all_names = self._header_names(filename)
+        label_idx = self._resolve_label_idx(all_names)
+        feat_names = None
+        if all_names is not None:
+            feat_names = list(all_names)
+            if 0 <= label_idx < len(feat_names):
+                feat_names.pop(label_idx)
+        widx = gidx = None
+        ignore: set = set()
+        if str(cfg.weight_column).strip():
+            (widx,) = _parse_column_spec(cfg.weight_column, feat_names)
+            ignore.add(widx)
+        if str(cfg.group_column).strip():
+            (gidx,) = _parse_column_spec(cfg.group_column, feat_names)
+            ignore.add(gidx)
+        for c in _parse_column_spec(cfg.ignore_column, feat_names):
+            ignore.add(c)
+
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_cap = max(int(cfg.bin_construct_sample_cnt), 1)
+        parser = None
+        sample_rows: List[np.ndarray] = []
+        gid_parts: List[np.ndarray] = []
+        n_kept = 0
+        max_f = 0
+
+        def _prep_chunk(labs, feats, start_global):
+            """striping + metadata-column extraction + ignore zeroing —
+            shared by both passes so sampled rows match pushed rows.
+            Returns the kept rows' GLOBAL indices so sidecar arrays
+            (indexed by global row) slice correctly under striping."""
+            gi = start_global + np.arange(len(labs))
+            if num_machines > 1 and not cfg.pre_partition:
+                sel = gi % num_machines == rank
+                labs, feats, gi = labs[sel], feats[sel], gi[sel]
+            w = feats[:, widx].copy() if widx is not None \
+                and widx < feats.shape[1] else None
+            gids = feats[:, gidx].copy() if gidx is not None \
+                and gidx < feats.shape[1] else None
+            for c in ignore:
+                if c < feats.shape[1]:
+                    feats[:, c] = 0.0
+            return labs, feats, w, gids, gi
+
+        n_global = 0
+        for lines in self._iter_line_chunks(filename, chunk_lines):
+            if parser is None:
+                parser = create_parser(lines[:32], label_idx)
+            labs, feats = parse_dense(lines, parser)
+            labs, feats, _w, gids, _gi = _prep_chunk(labs, feats, n_global)
+            n_global += len(lines)
+            max_f = max(max_f, feats.shape[1])
+            if gids is not None:
+                gid_parts.append(gids)
+            # vectorized reservoir sample (uniform without replacement,
+            # the reference Random::Sample analogue): fill to cap, then
+            # each row t replaces slot j ~ U[0, t] iff j < cap
+            k = feats.shape[0]
+            take = min(max(sample_cap - len(sample_rows), 0), k)
+            for i in range(take):
+                sample_rows.append(feats[i].copy())
+            if take < k:
+                t = n_kept + np.arange(take, k)
+                j = (rng.random_sample(k - take) * (t + 1)).astype(np.int64)
+                for i, slot in zip(np.nonzero(j < sample_cap)[0],
+                                   j[j < sample_cap]):
+                    sample_rows[slot] = feats[take + i].copy()
+            n_kept += k
+
+        if parser is None:
+            raise ValueError(f"data file {filename} is empty")
+
+        sample = np.zeros((len(sample_rows), max_f))
+        for i, r in enumerate(sample_rows):
+            sample[i, :len(r)] = r
+        del sample_rows
+
+        if reference is not None:
+            ds = Dataset.create_from_sample(None, n_kept, config=cfg,
+                                            reference=reference)
+        else:
+            ds = Dataset.create_from_sample(
+                sample, n_kept, config=cfg, feature_names=feat_names,
+                categorical_feature=self._categorical_from_config(
+                    feat_names))
+        del sample
+
+        # ---- pass 2: bin chunk-by-chunk straight into the uint8 matrix
+        side_w = _read_sidecar(filename + ".weight")
+        side_q = _read_sidecar(filename + ".query")
+        init_score = _read_sidecar(filename + ".init")
+        if cfg.initscore_filename and os.path.isfile(cfg.initscore_filename):
+            init_score = _read_sidecar(cfg.initscore_filename)
+        pos = 0
+        n_global = 0
+        num_cols = max_f if isinstance(parser, LibSVMParser) else None
+        raw_parts: List[np.ndarray] = []   # predict_fun chunks (may be 2-D)
+        kept_gi: List[np.ndarray] = []     # kept rows' global indices
+        for lines in self._iter_line_chunks(filename, chunk_lines):
+            labs, feats = parse_dense(lines, parser, num_cols=num_cols)
+            labs, feats, w, _, gi = _prep_chunk(labs, feats, n_global)
+            n_global += len(lines)
+            if feats.shape[1] < max_f:
+                feats = np.pad(feats, ((0, 0), (0, max_f - feats.shape[1])))
+            k = feats.shape[0]
+            if side_w is not None:
+                # sidecars are indexed by GLOBAL row: honor striping
+                w = side_w[gi]
+            ds.push_rows(feats, label=labs, weight=w)
+            if init_score is None and self.predict_fun is not None:
+                raw_parts.append(np.asarray(self.predict_fun(feats),
+                                            np.float64))
+            kept_gi.append(gi)
+            pos += k
+
+        group_sizes = None
+        if side_q is not None:
+            group_sizes = side_q.astype(np.int64)
+        elif gid_parts:
+            ids = np.concatenate(gid_parts)
+            change = np.flatnonzero(np.diff(ids) != 0)
+            bounds = np.concatenate([[0], change + 1, [len(ids)]])
+            group_sizes = np.diff(bounds).astype(np.int64)
+        ds.finish_load(group=group_sizes)
+        # init scores may be [N*K] column-major (multiclass): set whole
+        # (striping-gathered) arrays AFTER the push loop, mirroring the
+        # one-shot path's metadata.set_init_score semantics
+        if init_score is not None:
+            gsel = (np.concatenate(kept_gi) if kept_gi
+                    else np.zeros(0, np.int64))
+            if n_global and len(init_score) % n_global == 0:
+                ncls = len(init_score) // n_global
+                ds.metadata.set_init_score(np.concatenate(
+                    [init_score[c * n_global + gsel]
+                     for c in range(ncls)]))
+            else:
+                ds.metadata.set_init_score(init_score)
+        elif raw_parts:
+            raw = np.concatenate(raw_parts, axis=0)
+            ds.metadata.set_init_score(raw.reshape(-1, order="F"))
+        if cfg.save_binary:
+            ds.save_binary(filename + ".bin")
+        return ds
+
     def load_from_file_align_with_other_dataset(
             self, filename: str, reference: Dataset) -> Dataset:
         """Validation data binned with the training set's mappers
         (reference `dataset_loader.cpp:224`)."""
+        if getattr(self.config, "two_round", False):
+            return self._load_two_round(filename, reference=reference)
         labels, feats, ex = self.parse_file(filename)
         for c in ex["ignore"]:
             feats[:, c] = 0.0

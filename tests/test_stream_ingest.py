@@ -1,0 +1,172 @@
+"""Streaming two_round ingest (reference dataset_loader.cpp:162-266) and
+the push-rows creation flow (LGBM_DatasetCreateFromSampledColumn /
+LGBM_DatasetPushRows, c_api.h:52-256; VERDICT r3 item 6)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.io.loader import DatasetLoader
+
+
+def _write_csv(path, X, y, header=False, names=None):
+    with open(path, "w") as f:
+        if header:
+            f.write(",".join(["label"] + list(names)) + "\n")
+        for i in range(len(y)):
+            f.write(",".join([f"{y[i]:g}"] +
+                             [f"{v:.6g}" for v in X[i]]) + "\n")
+
+
+def _problem(n=5000, f=12, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def test_push_rows_matches_from_matrix():
+    X, y = _problem()
+    cfg = Config.from_params({"max_bin": 63, "verbosity": -1})
+    one = CoreDataset.from_matrix(X, label=y, config=cfg)
+    # stream in 7 uneven chunks; the full matrix IS the sample here so
+    # mappers match the one-shot path exactly
+    ds = CoreDataset.create_from_sample(X, len(y), config=cfg)
+    pos = 0
+    for k in (100, 900, 1500, 1000, 700, 500, 300):
+        ds.push_rows(X[pos:pos + k], label=y[pos:pos + k])
+        pos += k
+    ds.finish_load()
+    np.testing.assert_array_equal(ds.bins, one.bins)
+    np.testing.assert_allclose(ds.metadata.label, one.metadata.label)
+
+
+def test_push_rows_overflow_and_underflow_raise():
+    X, y = _problem(n=100)
+    cfg = Config.from_params({"verbosity": -1})
+    ds = CoreDataset.create_from_sample(X, 100, config=cfg)
+    ds.push_rows(X[:60], label=y[:60])
+    with pytest.raises(ValueError):
+        ds.push_rows(X, label=y)          # 60 + 100 > 100
+    with pytest.raises(ValueError):
+        ds.finish_load()                  # only 60 of 100 pushed
+
+
+def test_two_round_matches_one_shot(tmp_path):
+    X, y = _problem()
+    path = str(tmp_path / "train.csv")
+    _write_csv(path, X, y)
+    params = {"max_bin": 63, "verbosity": -1,
+              "bin_construct_sample_cnt": 100000}
+    one = DatasetLoader(Config.from_params(params)).load_from_file(path)
+    loader = DatasetLoader(Config.from_params(
+        dict(params, two_round=True)))
+    two = loader._load_two_round(path, chunk_lines=256)
+    # O(chunk) parsing: no chunk ever exceeded the cap
+    assert loader._max_chunk_rows <= 256
+    np.testing.assert_array_equal(two.bins, one.bins)
+    np.testing.assert_allclose(two.metadata.label, one.metadata.label)
+
+
+def test_two_round_sampled_binning_close(tmp_path):
+    """With a sample smaller than the file the mappers come from a
+    reservoir sample: bins differ slightly from the one-shot path's
+    random sample but training quality must hold."""
+    X, y = _problem(n=4000)
+    path = str(tmp_path / "train.csv")
+    _write_csv(path, X, y)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "metric": "auc", "verbosity": -1, "two_round": True,
+              "bin_construct_sample_cnt": 500}
+    ds = lgb.Dataset(path, params=params)
+    bst = lgb.train(params, ds, num_boost_round=20)
+    p = bst.predict(X)
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(len(p))
+    npos, nneg = y.sum(), (1 - y).sum()
+    auc = (ranks[y > 0].sum() - npos * (npos - 1) / 2) / (npos * nneg)
+    assert auc > 0.8
+
+
+def test_two_round_weight_and_query_sidecars(tmp_path):
+    X, y = _problem(n=600)
+    path = str(tmp_path / "rank.tsv")
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join([f"{y[i]:g}"] +
+                              [f"{v:.6g}" for v in X[i]]) + "\n")
+    w = np.linspace(0.5, 2.0, 600)
+    with open(path + ".weight", "w") as f:
+        f.write("\n".join(f"{v:.6g}" for v in w))
+    with open(path + ".query", "w") as f:
+        f.write("\n".join(["100"] * 6))
+    loader = DatasetLoader(Config.from_params(
+        {"two_round": True, "verbosity": -1}))
+    ds = loader._load_two_round(path, chunk_lines=128)
+    np.testing.assert_allclose(ds.metadata.weight, w, rtol=1e-5)
+    assert ds.metadata.num_queries == 6
+
+
+def test_two_round_striped_sidecar_weights(tmp_path):
+    """Distributed striping must gather sidecar weights by GLOBAL row
+    index, not kept-row position (code-review r4 finding)."""
+    X, y = _problem(n=400)
+    path = str(tmp_path / "t.tsv")
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join([f"{y[i]:g}"] +
+                              [f"{v:.6g}" for v in X[i]]) + "\n")
+    w = np.arange(400, dtype=np.float64) + 1.0
+    with open(path + ".weight", "w") as f:
+        f.write("\n".join(f"{v:g}" for v in w))
+    loader = DatasetLoader(Config.from_params(
+        {"two_round": True, "verbosity": -1}))
+    ds = loader._load_two_round(path, rank=1, num_machines=2,
+                                chunk_lines=64)
+    np.testing.assert_allclose(ds.metadata.weight, w[1::2])
+
+
+def test_two_round_libsvm_ragged(tmp_path):
+    """LibSVM rows carry different max column indices per chunk; the
+    second pass must bin at the GLOBAL width."""
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "data.svm")
+    n, f = 900, 10
+    rows = []
+    dense = np.zeros((n, f))
+    y = np.zeros(n)
+    for i in range(n):
+        y[i] = float(rng.integers(0, 2))
+        cols = sorted(rng.choice(f if i > n - 50 else 4, size=3,
+                                 replace=False))
+        toks = [f"{y[i]:g}"]
+        for c in cols:
+            v = float(rng.standard_normal())
+            dense[i, c] = v
+            toks.append(f"{c}:{v:.6g}")
+        rows.append(" ".join(toks))
+    with open(path, "w") as fh:
+        fh.write("\n".join(rows))
+    loader = DatasetLoader(Config.from_params(
+        {"two_round": True, "verbosity": -1}))
+    ds = loader._load_two_round(path, chunk_lines=100)
+    one = DatasetLoader(Config.from_params(
+        {"verbosity": -1})).load_from_file(path)
+    assert ds.num_total_features == one.num_total_features
+    np.testing.assert_allclose(ds.metadata.label, one.metadata.label)
+
+
+def test_dataset_accepts_file_path(tmp_path):
+    X, y = _problem(n=800)
+    path = str(tmp_path / "t.csv")
+    _write_csv(path, X, y)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    ds = lgb.Dataset(path, params=params).construct()
+    assert ds._handle.num_data == 800
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+    assert np.isfinite(bst.predict(X[:10])).all()
