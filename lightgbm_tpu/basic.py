@@ -553,6 +553,49 @@ class Booster:
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if isinstance(data, str):
+            # predict straight from a data file (reference
+            # LGBM_BoosterPredictForFile, c_api.h:645-704)
+            from .io.loader import DatasetLoader
+            cfg = Config.from_params({**self.params, **kwargs})
+            cfg.header = bool(kwargs.get("data_has_header",
+                                         kwargs.get("header", cfg.header)))
+            # label-free scoring files: when the file's column count
+            # equals the MODEL's feature count there is no label column
+            # to strip (the reference passes num_total_model_features to
+            # the parser for exactly this detection, predictor.hpp:185)
+            nf_model = (self._gbdt.train_data.num_total_features
+                        if self._gbdt is not None else
+                        self._loaded.get("max_feature_idx", -2) + 1)
+            with open(data, errors="replace") as f:
+                if cfg.header:
+                    f.readline()
+                first = f.readline()
+            ncols = 0
+            if first.strip():
+                if ":" in first and "," not in first:
+                    ncols = -1          # libsvm: sparse, keep default
+                else:
+                    for sep in ("\t", ",", " "):
+                        if sep in first:
+                            ncols = len(first.rstrip("\r\n").split(sep))
+                            break
+            if ncols == nf_model:
+                cfg.label_column = "-1"
+            _, feats, _ex = DatasetLoader(cfg).parse_file(data)
+            data = feats
+        if (hasattr(data, "tocsr") and not isinstance(data, np.ndarray)
+                and data.shape[0] > 65536):
+            # CSR/CSC input (reference LGBM_BoosterPredictForCSR/CSC,
+            # c_api.h:706-910): densify row CHUNKS, never the full
+            # matrix — peak memory is chunk x F doubles
+            csr = data.tocsr()
+            outs = []
+            for lo in range(0, csr.shape[0], 65536):
+                outs.append(self.predict(
+                    csr[lo:lo + 65536].toarray(), num_iteration,
+                    raw_score, pred_leaf, pred_contrib, **kwargs))
+            return np.concatenate(outs, axis=0)
         if (self.pandas_categorical and hasattr(data, "columns")
                 and hasattr(data, "values")):
             # remap predict-time category codes onto the TRAINING

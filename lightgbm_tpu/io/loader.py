@@ -328,36 +328,42 @@ class DatasetLoader:
                 gid_parts.append(gids)
             # vectorized reservoir sample (uniform without replacement,
             # the reference Random::Sample analogue): fill to cap, then
-            # each row t replaces slot j ~ U[0, t] iff j < cap
+            # each row t replaces slot j ~ U[0, t] iff j < cap. Skipped
+            # entirely with a reference: mappers are shared, so the
+            # aligned path keeps its O(chunk) promise
             k = feats.shape[0]
-            take = min(max(sample_cap - len(sample_rows), 0), k)
-            for i in range(take):
-                sample_rows.append(feats[i].copy())
-            if take < k:
-                t = n_kept + np.arange(take, k)
-                j = (rng.random_sample(k - take) * (t + 1)).astype(np.int64)
-                for i, slot in zip(np.nonzero(j < sample_cap)[0],
-                                   j[j < sample_cap]):
-                    sample_rows[slot] = feats[take + i].copy()
+            if reference is None:
+                take = min(max(sample_cap - len(sample_rows), 0), k)
+                for i in range(take):
+                    sample_rows.append(feats[i].copy())
+                if take < k:
+                    t = n_kept + np.arange(take, k)
+                    j = (rng.random_sample(k - take)
+                         * (t + 1)).astype(np.int64)
+                    for i, slot in zip(np.nonzero(j < sample_cap)[0],
+                                       j[j < sample_cap]):
+                        sample_rows[slot] = feats[take + i].copy()
             n_kept += k
 
         if parser is None:
             raise ValueError(f"data file {filename} is empty")
 
-        sample = np.zeros((len(sample_rows), max_f))
-        for i, r in enumerate(sample_rows):
-            sample[i, :len(r)] = r
-        del sample_rows
-
         if reference is not None:
+            # the training set may be wider than this file's rows reach
+            # (ragged LibSVM): bin at ITS width
+            max_f = max(max_f, reference.num_total_features)
             ds = Dataset.create_from_sample(None, n_kept, config=cfg,
                                             reference=reference)
         else:
+            sample = np.zeros((len(sample_rows), max_f))
+            for i, r in enumerate(sample_rows):
+                sample[i, :len(r)] = r
+            del sample_rows
             ds = Dataset.create_from_sample(
                 sample, n_kept, config=cfg, feature_names=feat_names,
                 categorical_feature=self._categorical_from_config(
                     feat_names))
-        del sample
+            del sample
 
         # ---- pass 2: bin chunk-by-chunk straight into the uint8 matrix
         side_w = _read_sidecar(filename + ".weight")

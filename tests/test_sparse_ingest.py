@@ -48,6 +48,46 @@ def test_csr_matches_dense():
     np.testing.assert_array_equal(ds_s._handle.bins, ds_d._handle.bins)
 
 
+def test_sparse_predict_chunked_matches_dense():
+    """CSR predict densifies row CHUNKS only (reference
+    LGBM_BoosterPredictForCSR, c_api.h:706-910)."""
+    X, y = _sparse_problem(n=3000, f=40, density=0.05)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+    big = scipy_sparse.vstack([X] * 30).tocsr()     # 90k rows > chunk
+    p_sparse = bst.predict(big)
+    p_dense = bst.predict(np.asarray(X.todense()))
+    np.testing.assert_allclose(p_sparse[:3000], p_dense, rtol=1e-12)
+    np.testing.assert_allclose(p_sparse[-3000:], p_dense, rtol=1e-12)
+
+
+def test_predict_from_file(tmp_path):
+    X, y = _sparse_problem(n=1000, f=20, density=0.1)
+    Xd = np.asarray(X.todense())
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    ds = lgb.Dataset(Xd, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+    path = str(tmp_path / "pred.tsv")
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join([f"{y[i]:g}"] +
+                              [f"{v:.9g}" for v in Xd[i]]) + "\n")
+    p_file = bst.predict(path)
+    p_mat = bst.predict(Xd)
+    np.testing.assert_allclose(p_file, p_mat, rtol=1e-6)
+    # label-FREE scoring file (the common layout): column count equals
+    # the model's feature count, so no label column is stripped
+    path2 = str(tmp_path / "pred_nolabel.tsv")
+    with open(path2, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join(f"{v:.9g}" for v in Xd[i]) + "\n")
+    p_file2 = bst.predict(path2)
+    np.testing.assert_allclose(p_file2, p_mat, rtol=1e-6)
+
+
 def test_csc_input_also_works():
     X, y = _sparse_problem(n=2000, f=30, density=0.05)
     params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
