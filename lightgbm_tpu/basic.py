@@ -583,19 +583,25 @@ class Booster:
             if ncols == nf_model:
                 cfg.label_column = "-1"
             _, feats, _ex = DatasetLoader(cfg).parse_file(data)
+            if nf_model > 0 and feats.shape[1] < nf_model:
+                # ragged LibSVM scoring rows: absent trailing features
+                # are zero (reference sparse convention)
+                feats = np.pad(feats,
+                               ((0, 0), (0, nf_model - feats.shape[1])))
             data = feats
-        if (hasattr(data, "tocsr") and not isinstance(data, np.ndarray)
-                and data.shape[0] > 65536):
+        if hasattr(data, "tocsr") and not isinstance(data, np.ndarray):
             # CSR/CSC input (reference LGBM_BoosterPredictForCSR/CSC,
-            # c_api.h:706-910): densify row CHUNKS, never the full
-            # matrix — peak memory is chunk x F doubles
-            csr = data.tocsr()
-            outs = []
-            for lo in range(0, csr.shape[0], 65536):
-                outs.append(self.predict(
-                    csr[lo:lo + 65536].toarray(), num_iteration,
-                    raw_score, pred_leaf, pred_contrib, **kwargs))
-            return np.concatenate(outs, axis=0)
+            # c_api.h:706-910): densify row CHUNKS under a constant
+            # ~256 MB byte budget, never the full matrix
+            rows_per = max(1, (256 << 20) // (8 * max(1, data.shape[1])))
+            if data.shape[0] > rows_per:
+                csr = data.tocsr()
+                outs = []
+                for lo in range(0, csr.shape[0], rows_per):
+                    outs.append(self.predict(
+                        csr[lo:lo + rows_per].toarray(), num_iteration,
+                        raw_score, pred_leaf, pred_contrib, **kwargs))
+                return np.concatenate(outs, axis=0)
         if (self.pandas_categorical and hasattr(data, "columns")
                 and hasattr(data, "values")):
             # remap predict-time category codes onto the TRAINING

@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.aligned import (R_CAT, R_COPY, R_DL, R_MT, R_SHIFT,
+from ..ops.aligned import (META_BAG, META_LABEL, META_RID_MASK, R_CAT,
+                           R_COPY, R_DL, R_MT, R_SHIFT, bins_per_word,
                            count_pass, lane_layout, move_pass,
                            pack_records, slot_hist_pass)
 from ..ops.histogram import NUM_HIST_STATS
@@ -77,13 +78,15 @@ def slot_in_any_map(begin, count, nc, chunk):
     undo_spec_scores (they must agree bit-for-bit: the undo subtracts
     exactly the valmap the build added). Begins are an exclusive cumsum
     over slot ids, so the containing slot is the LAST slot with
-    begin <= c (zero-width slots share a begin and lose the tie); the
-    O(S*nc) broadcast count vectorizes on the VPU where searchsorted
-    would serialize."""
+    begin <= c: scatter one count per slot at its begin position and
+    prefix-sum over chunks — O(S + nc) where the broadcast count
+    (sum over [S, nc] compares) cost ~4 ms/round at S=765, NC=22k."""
+    nslot = begin.shape[0]
     chunk_iota = jnp.arange(nc, dtype=jnp.int32)
-    slot_of = jnp.sum((begin[:, None] <= chunk_iota[None, :])
-                      .astype(jnp.int32), axis=0) - 1
-    slot_of = jnp.clip(slot_of, 0, begin.shape[0] - 1)
+    marks = jnp.zeros(nc + 1, jnp.int32).at[
+        jnp.clip(begin, 0, nc)].add(1)
+    slot_of = jnp.cumsum(marks[:nc]) - 1
+    slot_of = jnp.clip(slot_of, 0, nslot - 1)
     nch = (count + chunk - 1) // chunk
     in_range = ((chunk_iota >= begin[slot_of])
                 & (chunk_iota < begin[slot_of] + nch[slot_of])
@@ -142,9 +145,26 @@ class AlignedEngine:
         label = objective._label_np if objective._label_np is not None \
             else np.zeros(learner.n, np.float32)
         weight = objective._weight_np
+        # COMPACT record layout (ops/aligned.py lane_layout): pointwise
+        # unweighted objectives with 0/1 labels at max_bin <= 64 pack
+        # 6-bit bins 5/word, drop the grad/hess/label/weight lanes
+        # (gradients recompute in-kernel from score+label), and ride
+        # rid/label/bag in ONE meta lane — W 16 -> 8 at HIGGS shape,
+        # halving every DMA and the move pass's route matmul
+        lab01 = label is not None and np.all((np.asarray(label) == 0)
+                                             | (np.asarray(label) == 1))
+        self.compact = bool(
+            objective.point_grad_fn() is not None
+            and weight is None and lab01
+            and learner.n <= (1 << 24)      # rid must fit 24 meta bits
+            and learner.max_bin_global <= 64
+            and all(m.num_bin <= 64 for m in learner.ds.used_mappers()))
+        self.bits = 6 if self.compact else 8
         rec, self.wcnt, self.W, cnts = pack_records(
-            bins, label, weight, self.C, with_bag=bagged)
-        self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged)
+            bins, label, weight, self.C, with_bag=bagged,
+            compact=self.compact)
+        self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
+                                    compact=self.compact)
         self.n = learner.n
         L = self.cfg.num_leaves
         self.S = spec_slots(L, float(getattr(self.cfg, "tpu_level_spec",
@@ -182,7 +202,11 @@ class AlignedEngine:
     # ------------------------------------------------------------------
     def _grad_lanes(self, rec):
         """g/h record lanes from the score/label(/weight) lanes —
-        evaluated in PERMUTED row order (pointwise objectives only)."""
+        evaluated in PERMUTED row order (pointwise objectives only).
+        COMPACT records have no grad lanes: the kernels recompute g/h
+        from (score, label) at histogram time."""
+        if self.compact:
+            return rec
         ln = self.lanes
         score = _f32(rec[:, ln["score"], :])
         label = _f32(rec[:, ln["label"], :])
@@ -229,7 +253,11 @@ class AlignedEngine:
         group = 8 if B <= 64 else 4
         interpret = self.interpret
         bagged = self.bagged
-        bag_lane = ln["bag"] if bagged else -1
+        # bag: f32 lane (standard) or meta bit (-2, compact); -1 = none
+        bag_lane = (-2 if self.compact else ln["bag"]) if bagged else -1
+        bits = self.bits
+        bpw = bins_per_word(self.compact)
+        gfn = self._pgrad if self.compact else None
         axis = lr.axis_name
         dp = axis is not None and lr.parallel_mode == "data"
 
@@ -360,6 +388,8 @@ class AlignedEngine:
         def build(rec, cnts_pc, feature_mask_f32, scale_in, prev_ok,
                   g_rows=None, h_rows=None):
             if external_grads:
+                assert not self.compact, \
+                    "external grads need grad lanes (standard layout)"
                 rid = jnp.clip(rec[:, ln["rid"], :], 0, self.n - 1)
                 ge = g_rows[rid]
                 he = h_rows[rid]
@@ -376,7 +406,8 @@ class AlignedEngine:
             root_slots = jnp.zeros(NC, jnp.int32)
             root_hist_all = slot_hist_pass(rec, root_slots, cnts_pc, 1,
                                            F, B, C, group, wcnt,
-                                           bag_lane=bag_lane,
+                                           bag_lane=bag_lane, bits=bits,
+                                           grad_fn=gfn,
                                            interpret=interpret)
             root_hist = _gsum(root_hist_all[0])
             root_g = jnp.sum(root_hist[0, :, 0])
@@ -483,8 +514,8 @@ class AlignedEngine:
                 # counting pass over the rows needed. (A data-parallel
                 # port needs a per-shard count pass here.)
                 feat = bestI[:, BI_FEAT]
-                wsel_s = feat >> 2
-                shift_s = (feat & 3) * 8
+                wsel_s = feat // bpw
+                shift_s = (feat % bpw) * bits
                 # route words + chunk meta (shared by the count pass and
                 # the move pass; both read the OLD layout)
                 r1_s = (jnp.clip(bestI[:, BI_THR], 0, 255)
@@ -520,7 +551,7 @@ class AlignedEngine:
                                       ks_s[slot_of], K)
                     phys = count_pass(rec, r1_pc, r2_pc, meta_pc,
                                       wsel_pc, ks_pc, cbits, K, C,
-                                      interpret=interpret)
+                                      bits=bits, interpret=interpret)
                     left_local = jnp.where(
                         sel, phys[jnp.clip(selrank, 0, K - 1)],
                         leafI[:, LI_COUNT])
@@ -561,8 +592,8 @@ class AlignedEngine:
                 rec, hout = move_pass(rec, r1_pc, r2_pc, bl_pc, br_pc,
                                       meta_pc, wsel_pc, hslots_pc, cbits,
                                       C, W, wcnt, K, F, B, group,
-                                      bag_lane=bag_lane,
-                                      interpret=interpret)
+                                      bag_lane=bag_lane, bits=bits,
+                                      grad_fn=gfn, interpret=interpret)
 
                 # ---- updated tables (begins relaid for ALL slots)
                 depth_new = leafI[:, LI_DEPTH] + 1
@@ -885,8 +916,17 @@ class AlignedEngine:
     def _set_bag_program(self):
         ln = self.lanes
         n = self.n
+        compact = self.compact
 
         def fn(rec, mask):
+            if compact:
+                meta = rec[:, ln["meta"], :]
+                rid = jnp.clip(meta & META_RID_MASK, 0, n)
+                vals = jnp.concatenate(
+                    [mask, jnp.zeros(1, jnp.float32)])[rid]
+                meta = (meta & ~(1 << META_BAG)) | (
+                    (vals > 0.5).astype(jnp.int32) << META_BAG)
+                return rec.at[:, ln["meta"], :].set(meta)
             rid = jnp.clip(rec[:, ln["rid"], :], 0, n)
             vals = jnp.concatenate([mask, jnp.zeros(1, jnp.float32)])[rid]
             return rec.at[:, ln["bag"], :].set(_i32(vals))
@@ -900,12 +940,19 @@ class AlignedEngine:
         self._score_cache = None
         self._last_exact = jnp.asarray(True)   # lane is authoritative again
 
-    def _set_scores_program(self):
+    def _rid_lanes(self, rec):
+        """Row ids per record cell (compact: low 24 meta bits)."""
         ln = self.lanes
+        if self.compact:
+            return rec[:, ln["meta"], :] & META_RID_MASK
+        return rec[:, ln["rid"], :]
+
+    def _set_scores_program(self):
         n = self.n
+        ln = self.lanes
 
         def fn(rec, scores):
-            rid = jnp.clip(rec[:, ln["rid"], :], 0, n - 1)
+            rid = jnp.clip(self._rid_lanes(rec), 0, n - 1)
             vals = scores[rid]
             return rec.at[:, ln["score"], :].set(_i32(vals))
         return fn
@@ -925,7 +972,7 @@ class AlignedEngine:
         n, C, NC = self.n, self.C, self.NC
 
         def fn(rec, cnts):
-            rid = rec[:, ln["rid"], :].reshape(-1)
+            rid = self._rid_lanes(rec).reshape(-1)
             sc = _f32(rec[:, ln["score"], :]).reshape(-1)
             pos = jnp.arange(C, dtype=jnp.int32)
             valid = (pos[None, :] < cnts[:, None]).reshape(-1)

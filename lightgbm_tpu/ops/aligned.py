@@ -84,45 +84,85 @@ def aligned_num_chunks(n: int, cfg, spec_slots: int) -> int:
     return (n + C - 1) // C + spec_slots + 2
 
 
-def lane_layout(wcnt: int, with_bag: bool = False):
-    """(lane indices, padded W) for a record with `wcnt` bin words."""
+# compact meta-lane bit layout: rid | label << 24 | bag << 25
+META_RID_MASK = (1 << 24) - 1
+META_LABEL = 24
+META_BAG = 25
+
+
+def bins_per_word(compact: bool) -> int:
+    """COMPACT records pack 5 six-bit bins per word (max_bin <= 64, the
+    reference's 4-bit dense_nbits_bin.hpp:42 analogue at TPU-natural
+    width); standard records pack 4 eight-bit bins."""
+    return 5 if compact else 4
+
+
+def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False):
+    """(lane indices, padded W) for a record with `wcnt` bin words.
+
+    COMPACT layout (pointwise objectives with 0/1 labels, unweighted,
+    n <= 2^24): bin words + score + meta, where meta packs
+    rid | label << 24 | bag << 25 — gradients are recomputed in-kernel
+    from (score, label) instead of riding as lanes, halving the record
+    (W 16 -> 8 at HIGGS shape) and with it every DMA and the route
+    matmul of the move pass."""
     ls = wcnt
-    lanes = dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
-                 rid=ls + 4, weight=ls + 5)
-    w = wcnt + 6
-    if with_bag:
-        lanes["bag"] = w
-        w += 1
+    if compact:
+        lanes = dict(score=ls, meta=ls + 1)
+        w = wcnt + 2
+    else:
+        lanes = dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
+                     rid=ls + 4, weight=ls + 5)
+        w = wcnt + 6
+        if with_bag:
+            lanes["bag"] = w
+            w += 1
     w_pad = ((w + 7) // 8) * 8
     return lanes, w_pad
 
 
 def pack_records(bins: np.ndarray, label: np.ndarray,
-                 weight, chunk: int, with_bag: bool = False):
+                 weight, chunk: int, with_bag: bool = False,
+                 compact: bool = False):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
     rows in chunk i (C except the last).
     """
     n, f = bins.shape
-    wcnt = (f + 3) // 4
-    lanes, w_pad = lane_layout(wcnt, with_bag)
+    bpw = bins_per_word(compact)
+    wcnt = (f + bpw - 1) // bpw
+    lanes, w_pad = lane_layout(wcnt, with_bag, compact)
     nc = (n + chunk - 1) // chunk
     n_pad = nc * chunk
-    padded = np.zeros((n_pad, wcnt * 4), np.uint8)
+    padded = np.zeros((n_pad, wcnt * bpw), np.uint8)
     padded[:n, :f] = bins
-    words = padded.reshape(n_pad, wcnt, 4).astype(np.uint32)
-    packed = (words[:, :, 0] | (words[:, :, 1] << 8)
-              | (words[:, :, 2] << 16) | (words[:, :, 3] << 24))
+    words = padded.reshape(n_pad, wcnt, bpw).astype(np.uint32)
+    if compact:
+        packed = np.zeros((n_pad, wcnt), np.uint32)
+        for i in range(bpw):
+            packed |= words[:, :, i] << (6 * i)
+    else:
+        packed = (words[:, :, 0] | (words[:, :, 1] << 8)
+                  | (words[:, :, 2] << 16) | (words[:, :, 3] << 24))
     rec = np.zeros((n_pad, w_pad), np.int32)
     rec[:, :wcnt] = packed.astype(np.int64).astype(np.int32)
-    rec[:n, lanes["label"]] = np.asarray(label, np.float32).view(np.int32)
-    rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
-    wv = np.ones(n, np.float32) if weight is None \
-        else np.asarray(weight, np.float32)
-    rec[:n, lanes["weight"]] = wv.view(np.int32)
-    if with_bag:
-        rec[:n, lanes["bag"]] = np.ones(n, np.float32).view(np.int32)
+    if compact:
+        lab = (np.asarray(label) > 0).astype(np.int64)
+        meta = np.arange(n_pad, dtype=np.int64)
+        meta[:n] |= lab << META_LABEL
+        meta[:n] |= 1 << META_BAG     # all rows in-bag initially
+        rec[:, lanes["meta"]] = meta.astype(np.int64).astype(np.uint32) \
+            .view(np.int32)
+    else:
+        rec[:n, lanes["label"]] = np.asarray(label, np.float32) \
+            .view(np.int32)
+        rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
+        wv = np.ones(n, np.float32) if weight is None \
+            else np.asarray(weight, np.float32)
+        rec[:n, lanes["weight"]] = wv.view(np.int32)
+        if with_bag:
+            rec[:n, lanes["bag"]] = np.ones(n, np.float32).view(np.int32)
     rec3 = np.ascontiguousarray(
         rec.reshape(nc, chunk, w_pad).transpose(0, 2, 1))
     cnts = np.full(nc, chunk, np.int32)
@@ -175,6 +215,30 @@ def _cat_word(cbits_ref, ks, binv):
 
 
 
+def _payload_gh(rows, nvalid, chunk, wcnt, grad_fn, bag_lane):
+    """(g, h, take) for a [W, C] row block: lane-resident gradients
+    (standard layout) or recomputed in-kernel from (score, label)
+    (compact layout, grad_fn not None — the objective's pointwise
+    gradient inlined into the Pallas kernel)."""
+    posh = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
+    take = posh < nvalid
+    if grad_fn is not None:
+        score = lax.bitcast_convert_type(rows[wcnt, :], jnp.float32)
+        meta = rows[wcnt + 1, :]
+        label = ((meta >> META_LABEL) & 1).astype(jnp.float32)
+        g, h = grad_fn(score, label, None)
+        if bag_lane == -2:     # compact bagging: bag bit masks stats
+            take = take & (((meta >> META_BAG) & 1) != 0)
+    else:
+        g = lax.bitcast_convert_type(rows[wcnt + 2, :], jnp.float32)
+        h = lax.bitcast_convert_type(rows[wcnt + 3, :], jnp.float32)
+        if bag_lane >= 0:
+            bagv = lax.bitcast_convert_type(rows[bag_lane, :],
+                                            jnp.float32)
+            take = take & (bagv > 0.5)
+    return g, h, take
+
+
 def _hi_lo6(pay):
     """Split [3, C] f32 payload rows into an exact [6, C] bf16 (hi, lo)
     pair via mantissa TRUNCATION: hi = pay with the low 16 mantissa bits
@@ -194,7 +258,8 @@ def _hi_lo6(pay):
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  hslot_ref, cbits_ref, rec_ref, out_ref, hist_ref, stag,
                  fbuf, hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
-                 num_features, b_pad, group, dummy, bag_lane):
+                 num_features, b_pad, group, dummy, bag_lane,
+                 bits, grad_fn):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
@@ -250,21 +315,16 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                               sems.at[slot]).wait()
         cur_ref[4 + slot] = 0
 
+    bpw = 5 if bits == 6 else 4
+    bmask = (1 << bits) - 1
+
     def hist_flushed(rows, nvalid):
         """Accumulate a flushed [W, C] chunk of the smaller child (first
         nvalid rows valid) into the per-block accumulator: flushed
         buffers hold the side's rows COMPACTED, so the one-hot work runs
-        at full density on exactly the smaller child's rows."""
-        posh = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
-        take = posh < nvalid
-        if bag_lane >= 0:
-            # bagging: the histogram's g/h/cnt stats cover IN-BAG rows
-            # only (gbdt.cpp:209-275 trains on the bagged subset)
-            bagv = lax.bitcast_convert_type(rows[bag_lane, :],
-                                            jnp.float32)
-            take = take & (bagv > 0.5)
-        g = lax.bitcast_convert_type(rows[wcnt + 2, :], jnp.float32)
-        h = lax.bitcast_convert_type(rows[wcnt + 3, :], jnp.float32)
+        at full density on exactly the smaller child's rows. Bagged
+        stats cover IN-BAG rows only (gbdt.cpp:209-275)."""
+        g, h, take = _payload_gh(rows, nvalid, C, wcnt, grad_fn, bag_lane)
         gm = jnp.where(take, g, 0.0)
         hm = jnp.where(take, h, 0.0)
         cntp = take.astype(jnp.float32)
@@ -276,8 +336,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
             ohs = []
             for j in range(group):
                 f = min(gi * group + j, num_features - 1)
-                wf = rows[f >> 2, :]
-                bv = (wf >> ((f & 3) * 8)) & 255
+                wf = rows[f // bpw, :]
+                bv = (wf >> ((f % bpw) * bits)) & bmask
                 ohs.append((bv[None, :] == iota_b).astype(jnp.bfloat16))
             onehot = jnp.concatenate(ohs, axis=0)
             contrib = lax.dot_general(pay6, onehot,
@@ -314,7 +374,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         word = rec[0, :]
         for wj in range(1, wcnt):
             word = jnp.where(wsel == wj, rec[wj, :], word)
-        binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
+        binv = (word >> ((r1 >> R_SHIFT) & 31)) & bmask
         catw = _cat_word(cbits_ref, hs & 0xFFFFFF, binv)
         left = _goes_left(binv, r1, r2_ref[i], valid, catw)
 
@@ -426,10 +486,10 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
-    "group", "bag_lane", "interpret"))
+    "group", "bag_lane", "bits", "grad_fn", "interpret"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
               chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
-              bag_lane=-1, interpret=False):
+              bag_lane=-1, bits=8, grad_fn=None, interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -458,7 +518,8 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
     kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
                                wcnt=wcnt, num_features=num_features,
                                b_pad=b_pad, group=group, dummy=dummy,
-                               bag_lane=bag_lane)
+                               bag_lane=bag_lane, bits=bits,
+                               grad_fn=grad_fn)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -506,7 +567,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
 # physical left-count pass
 # ---------------------------------------------------------------------------
 def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
-                  rec_ref, out_ref, cacc, *, chunk, dummy):
+                  rec_ref, out_ref, cacc, *, chunk, dummy, bits):
     """Exact i32 count of PHYSICAL rows routed left per selected split.
 
     Streams only each block's split-word sublane (4 B/row). Needed when
@@ -535,7 +596,7 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
         for wj in range(1, 8):
             word = jnp.where(wsub == wj, rec_ref[0, wj], word)
         r1 = r1_ref[i]
-        binv = (word >> ((r1 >> R_SHIFT) & 31)) & 255
+        binv = (word >> ((r1 >> R_SHIFT) & 31)) & ((1 << bits) - 1)
         pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
         valid = pos < (meta & ((1 << 20) - 1))
         catw = _cat_word(cbits_ref, ks_ref[i], binv)
@@ -548,9 +609,9 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "chunk",
-                                             "interpret"))
+                                             "bits", "interpret"))
 def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
-               chunk, interpret=False):
+               chunk, bits=8, interpret=False):
     """[num_slots] i32 physical left counts per compact slot id.
 
     kslots[i] = compact id of chunk i's selected split (num_slots =
@@ -559,7 +620,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
     nc = records.shape[0]
     w_pad = records.shape[1]
     kernel = functools.partial(_count_kernel, chunk=chunk,
-                               dummy=num_slots)
+                               dummy=num_slots, bits=bits)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(nc,),
@@ -584,8 +645,10 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
 # ---------------------------------------------------------------------------
 def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
                       num_features, b_pad, group, chunk, wcnt, dummy,
-                      bag_lane):
+                      bag_lane, bits, grad_fn):
     i = pl.program_id(0)
+    bpw = 5 if bits == 6 else 4
+    bmask = (1 << bits) - 1
 
     @pl.when(i == 0)
     def _():
@@ -595,13 +658,8 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
     def _():
         rec = rec_ref[0]                              # [W, C]
         ks = slots_ref[i]
-        g = lax.bitcast_convert_type(rec[wcnt + 2, :], jnp.float32)
-        h = lax.bitcast_convert_type(rec[wcnt + 3, :], jnp.float32)
-        pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
-        valid = pos < (meta_ref[i] & ((1 << 20) - 1))
-        if bag_lane >= 0:
-            bagv = lax.bitcast_convert_type(rec[bag_lane, :], jnp.float32)
-            valid = valid & (bagv > 0.5)
+        g, h, valid = _payload_gh(rec, meta_ref[i] & ((1 << 20) - 1),
+                                  chunk, wcnt, grad_fn, bag_lane)
         gm = jnp.where(valid, g, 0.0)
         hm = jnp.where(valid, h, 0.0)
         cnt = valid.astype(jnp.float32)
@@ -614,8 +672,8 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
             ohs = []
             for j in range(group):
                 f = min(gi * group + j, num_features - 1)
-                w = rec[f >> 2, :]
-                binv = (w >> ((f & 3) * 8)) & 255
+                w = rec[f // bpw, :]
+                binv = (w >> ((f % bpw) * bits)) & bmask
                 ohs.append((binv[None, :] == iota_b).astype(jnp.bfloat16))
             onehot = jnp.concatenate(ohs, axis=0)     # [group*b_pad, C]
             contrib = lax.dot_general(pay6, onehot,
@@ -626,9 +684,10 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
-    "bag_lane", "interpret"))
+    "bag_lane", "bits", "grad_fn", "interpret"))
 def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
-                   chunk, group, wcnt, bag_lane=-1, interpret=False):
+                   chunk, group, wcnt, bag_lane=-1, bits=8, grad_fn=None,
+                   interpret=False):
     """hist[num_slots, F, b_pad, 3] over the record matrix.
 
     slots[i] maps chunk i to its accumulation slot (a COMPACT id —
@@ -643,7 +702,8 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
     ngroups = (num_features + group - 1) // group
     kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
                                b_pad=b_pad, group=group, chunk=chunk,
-                               wcnt=wcnt, dummy=dummy, bag_lane=bag_lane)
+                               wcnt=wcnt, dummy=dummy, bag_lane=bag_lane,
+                               bits=bits, grad_fn=grad_fn)
     w_pad = records.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

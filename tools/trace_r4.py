@@ -1,6 +1,7 @@
 #!/usr/bin/env python
-"""Trace N aligned iterations with jax.profiler and aggregate device op
-durations from the perfetto json. Usage: python tools/trace_r4.py [n]"""
+"""Trace N aligned iterations with jax.profiler and aggregate DEVICE op
+durations from the perfetto json (host python frames filtered out via the
+per-pid process names). Usage: python tools/trace_r4.py [n]"""
 import glob
 import gzip
 import json
@@ -14,7 +15,8 @@ import jax
 import numpy as np
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
-MB = 63
+MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
+NTRACE = 4
 CACHE = f"/tmp/higgs_shape_{N}_{MB}.npz"
 LOG = "/tmp/jaxtrace_r4"
 
@@ -31,35 +33,52 @@ def main():
                             params=params).construct()
     bst = lgb.Booster(params=params, train_set=train_set)
     gb = bst._gbdt
-    for _ in range(6):
+    import time
+    for i in range(10):
+        t0 = time.perf_counter()
         gb.train_one_iter()
-    jax.block_until_ready(gb._aligned_eng_ref.rec)
+        jax.block_until_ready(gb._aligned_eng_ref.rec[0, 0, :1])
+        print(f"warm iter {i}: {time.perf_counter()-t0:.3f}s", flush=True)
     os.system(f"rm -rf {LOG}")
+    t0 = time.perf_counter()
     with jax.profiler.trace(LOG):
-        for _ in range(3):
+        for _ in range(NTRACE):
             gb.train_one_iter()
-        jax.block_until_ready(gb._aligned_eng_ref.rec)
+        jax.block_until_ready(gb._aligned_eng_ref.rec[0, 0, :1])
+    wall = time.perf_counter() - t0
+    print(f"traced {NTRACE} iters wall={wall:.3f}s "
+          f"({wall/NTRACE*1000:.1f} ms/iter)", flush=True)
+    print("fallbacks:", getattr(gb._aligned_eng_ref, "fallbacks", 0))
 
     files = glob.glob(f"{LOG}/**/*.trace.json.gz", recursive=True)
-    print("trace files:", files, flush=True)
     agg = defaultdict(float)
     cnt = defaultdict(int)
     for fn in files:
         with gzip.open(fn, "rt") as f:
             data = json.load(f)
-        for ev in data.get("traceEvents", []):
+        evs = data.get("traceEvents", [])
+        # pid -> process name from metadata events; device lanes look
+        # like "/device:TPU:0" or "TPU:0" or contain "XLA Op"
+        pname = {}
+        for ev in evs:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pname[ev.get("pid")] = ev.get("args", {}).get("name", "")
+        dev_pids = {p for p, nm in pname.items()
+                    if "TPU" in nm or "device" in nm.lower()}
+        print("processes:", sorted(pname.values())[:20], flush=True)
+        for ev in evs:
             if ev.get("ph") != "X":
                 continue
-            # device lanes only: pid names like "/device:TPU:0" appear in
-            # metadata; keep every complete event and let names sort it
-            name = ev.get("name", "")
-            dur = ev.get("dur", 0)
-            agg[name] += dur
-            cnt[name] += 1
-    top = sorted(agg.items(), key=lambda kv: -kv[1])[:45]
+            if dev_pids and ev.get("pid") not in dev_pids:
+                continue
+            agg[ev.get("name", "")] += ev.get("dur", 0)
+            cnt[ev.get("name", "")] += 1
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
+    tot = sum(agg.values())
+    print(f"device total {tot/1e3/NTRACE:.1f} ms/iter", flush=True)
     for name, us in top:
-        print(f"{us/3000.0:9.2f} ms/iter  x{cnt[name]//3:<6} {name[:110]}",
-              flush=True)
+        print(f"{us/(1e3*NTRACE):9.2f} ms/iter  x{cnt[name]//NTRACE:<6} "
+              f"{name[:100]}", flush=True)
 
 
 if __name__ == "__main__":
