@@ -583,9 +583,11 @@ class Booster:
             if ncols == nf_model:
                 cfg.label_column = "-1"
             _, feats, _ex = DatasetLoader(cfg).parse_file(data)
-            if nf_model > 0 and feats.shape[1] < nf_model:
+            if ncols == -1 and nf_model > 0 and feats.shape[1] < nf_model:
                 # ragged LibSVM scoring rows: absent trailing features
-                # are zero (reference sparse convention)
+                # are zero (reference sparse convention). Dense files
+                # with too few columns stay unpadded — a feature-count
+                # mismatch is an error, not missing data
                 feats = np.pad(feats,
                                ((0, 0), (0, nf_model - feats.shape[1])))
             data = feats

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.aligned import (META_BAG, META_LABEL, META_RID_MASK, R_CAT,
+from ..ops.aligned import (META_BAG, META_RID_MASK, R_CAT,
                            R_COPY, R_DL, R_MT, R_SHIFT, bins_per_word,
                            count_pass, lane_layout, move_pass,
                            pack_records, slot_hist_pass)

@@ -97,6 +97,10 @@ def bins_per_word(compact: bool) -> int:
     return 5 if compact else 4
 
 
+def _bpw_for_bits(bits: int) -> int:
+    return bins_per_word(bits == 6)
+
+
 def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False):
     """(lane indices, padded W) for a record with `wcnt` bin words.
 
@@ -256,7 +260,8 @@ def _hi_lo6(pay):
 
 
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
-                 hslot_ref, cbits_ref, rec_ref, out_ref, hist_ref, stag,
+                 hslot_ref, cbits_ref, fetch_ref, rec_ref, rec_hbm_ref,
+                 out_ref, hist_ref, stag,
                  fbuf, hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
                  num_features, b_pad, group, dummy, bag_lane,
                  bits, grad_fn):
@@ -268,15 +273,18 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
     DIRECTLY from the chunk's smaller-side rows into a VMEM-resident
     store indexed by COMPACT per-round slot ids (constant out-spec: the
     whole [K+1, ...] store lives in VMEM across the grid and flushes
-    once). COPY chunks (unsplit blocks): one buffered DMA to the
-    prefetched direct destination, no compute.
+    once). COPY chunks (unsplit blocks): one direct HBM->HBM DMA to the
+    prefetched destination — no VMEM staging, and the blocked input
+    pipeline SKIPS the fetch (fetch_ref holds the last split chunk's
+    index, so the block index doesn't change on copy runs).
 
     Flushes are ASYNC: each staging half is copied to one of two per-side
-    flush buffers and DMA'd without waiting; a buffer is reused only
-    after its previous DMA is waited on (pending flags in SMEM), and the
-    final grid step drains all outstanding DMAs.
+    flush buffers and DMA'd without waiting; a buffer/semaphore is reused
+    only after its previous DMA is waited on (pending flags in SMEM),
+    and the final grid step drains all outstanding DMAs.
 
-    cur_ref: [cur_l, cur_r, fl_l, fl_r, pend0..5, dst0..5]."""
+    cur_ref: [cur_l, cur_r, fl_l, fl_r, pend 4..15, dst 16..27,
+    src 28..39]; slots 0-3 = VMEM flush, 4-11 = HBM->HBM copy."""
     i = pl.program_id(0)
     C = chunk
     r1 = r1_ref[i]
@@ -286,8 +294,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
     @pl.when(i == 0)
     def _():
         # SMEM scratch is NOT zero-initialized: clear the DMA pending
-        # flags (4..9) and saved destinations (10..15) before any use
-        for j in range(16):
+        # flags and saved src/dst indices before any use
+        for j in range(40):
             cur_ref[j] = 0
         hist_ref[...] = jnp.zeros_like(hist_ref)
 
@@ -310,12 +318,17 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
     hs = hslot_ref[i]
 
     def wait_slot(slot):
-        pltpu.make_async_copy(fbuf.at[slot],
-                              out_ref.at[cur_ref[10 + slot]],
-                              sems.at[slot]).wait()
+        if slot < 4:            # static: flush slots DMA from VMEM
+            pltpu.make_async_copy(fbuf.at[slot],
+                                  out_ref.at[cur_ref[16 + slot]],
+                                  sems.at[slot]).wait()
+        else:                   # copy slots DMA HBM->HBM
+            pltpu.make_async_copy(rec_hbm_ref.at[cur_ref[28 + slot]],
+                                  out_ref.at[cur_ref[16 + slot]],
+                                  sems.at[slot]).wait()
         cur_ref[4 + slot] = 0
 
-    bpw = 5 if bits == 6 else 4
+    bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
 
     def hist_flushed(rows, nvalid):
@@ -346,26 +359,27 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
             hacc[gi] += contrib
 
     # ---- copy fast-path: unsplit blocks shift as whole chunks — one
-    # buffered DMA to the prefetched direct destination (bl), no compute
+    # direct HBM->HBM DMA to the prefetched destination (bl): no fetch,
+    # no VMEM staging, 8 DMAs in flight
     bl_i = blbr_ref[i] & 0xFFFF
     br_i = (blbr_ref[i] >> 16) & 0xFFFF
 
     @pl.when((is_copy != 0) & (cntv > 0))
     def _():
-        for cp in range(2):
-            @pl.when((i % 2) == cp)
+        for cp in range(8):
+            @pl.when((i % 8) == cp)
             def _():
                 slot = 4 + cp
 
                 @pl.when(cur_ref[4 + slot] != 0)
                 def _():
                     wait_slot(slot)
-                fbuf[slot] = rec
                 pltpu.make_async_copy(
-                    fbuf.at[slot], out_ref.at[bl_i],
+                    rec_hbm_ref.at[i], out_ref.at[bl_i],
                     sems.at[slot]).start()
                 cur_ref[4 + slot] = 1
-                cur_ref[10 + slot] = bl_i
+                cur_ref[16 + slot] = bl_i
+                cur_ref[28 + slot] = i
 
     # ---- split path
     @pl.when(is_copy == 0)
@@ -454,7 +468,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                                 fbuf.at[slot], out_ref.at[base + fl],
                                 sems.at[slot]).start()
                             cur_ref[4 + slot] = 1
-                            cur_ref[10 + slot] = base + fl
+                            cur_ref[16 + slot] = base + fl
 
                             @pl.when(((hs & 0xFFFFFF) != dummy)
                                      & (((hs >> 24) & 1) == side))
@@ -478,7 +492,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
     @pl.when(i == pl.num_programs(0) - 1)   # drain outstanding DMAs
     def _():
-        for slot in range(6):
+        for slot in range(12):
             @pl.when(cur_ref[4 + slot] != 0)
             def _():
                 wait_slot(slot)
@@ -522,26 +536,33 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
                                grad_fn=grad_fn)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
+    # copy chunks SKIP the blocked fetch: the block index carries the
+    # last split chunk's index forward, so the pipeline only fetches
+    # when the index changes (i.e. at split chunks)
+    iota_nc = jnp.arange(nc, dtype=jnp.int32)
+    is_split = ((r1 >> R_COPY) & 1) == 0
+    fetch_idx = lax.cummax(jnp.where(is_split, iota_nc, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
+        num_scalar_prefetch=7,
         grid=(nc,),
         in_specs=[
             pl.BlockSpec((1, w_pad, chunk),
-                         lambda i, a, b, c, d, e, f: (i, 0, 0)),
+                         lambda i, a, b, c, d, e, f, g: (g[i], 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.HBM),   # DMA src for copies
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.HBM),
             # constant index map: the compact hist store is resident in
             # VMEM for the whole pass and written back once at the end
             pl.BlockSpec((num_slots + 1, ngroups, 6, group * b_pad),
-                         lambda i, a, b, c, d, e, f: (0, 0, 0, 0)),
+                         lambda i, a, b, c, d, e, f, g: (0, 0, 0, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
-            pltpu.VMEM((6, w_pad, chunk), jnp.int32),   # flush+copy bufs
+            pltpu.VMEM((4, w_pad, chunk), jnp.int32),   # flush bufs
             pltpu.VMEM((ngroups, 6, group * b_pad), jnp.float32),
-            pltpu.SMEM((16,), jnp.int32),
-            pltpu.SemaphoreType.DMA((6,)),
+            pltpu.SMEM((40,), jnp.int32),
+            pltpu.SemaphoreType.DMA((12,)),
         ],
     )
     out, hist = pl.pallas_call(
@@ -555,7 +576,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 << 20, has_side_effects=True),
         interpret=interpret,
-    )(r1p, r2, blbr, meta, hslots, cbits, records)
+    )(r1p, r2, blbr, meta, hslots, cbits, fetch_idx, records, records)
     hist = hist.reshape(num_slots + 1, ngroups, 6, group, b_pad)
     hist = hist[:, :, :3] + hist[:, :, 3:]
     hist = jnp.moveaxis(hist, 2, 4)
@@ -647,7 +668,7 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
                       num_features, b_pad, group, chunk, wcnt, dummy,
                       bag_lane, bits, grad_fn):
     i = pl.program_id(0)
-    bpw = 5 if bits == 6 else 4
+    bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
 
     @pl.when(i == 0)
