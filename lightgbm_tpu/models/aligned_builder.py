@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.aligned import (META_BAG, META_RID_MASK, R_CAT,
+from ..ops.aligned import (META_BAG, META_LABEL, META_LABEL_MASK,
+                           META_RID_MASK, R_CAT,
                            R_COPY, R_DL, R_MT, R_SHIFT, bins_per_word,
                            count_pass, lane_layout, move_pass,
                            pack_records, slot_hist_pass)
@@ -126,12 +127,14 @@ class AlignedEngine:
     """
 
     def __init__(self, learner, objective, interpret: bool = False,
-                 init_row_scores=None, bagged: bool = False):
+                 init_row_scores=None, bagged: bool = False,
+                 num_class: int = 1):
         self.learner = learner
         self.objective = objective
         self.cfg = learner.cfg
         self.interpret = interpret
         self.bagged = bagged
+        self.num_class = num_class
         # 512 measured best on v5e at 10.5M rows: 256 halves the
         # permutation matmul but doubles grid/DMA/glue fixed costs
         # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
@@ -153,18 +156,35 @@ class AlignedEngine:
         # halving every DMA and the move pass's route matmul
         lab01 = label is not None and np.all((np.asarray(label) == 0)
                                              | (np.asarray(label) == 1))
-        self.compact = bool(
-            objective.point_grad_fn() is not None
-            and weight is None and lab01
-            and learner.n <= (1 << 24)      # rid must fit 24 meta bits
-            and learner.max_bin_global <= 64
-            and all(m.num_bin <= 64 for m in learner.ds.used_mappers()))
-        self.bits = 6 if self.compact else 8
-        rec, self.wcnt, self.W, cnts = pack_records(
+        if num_class > 1:
+            # multiclass REQUIRES the compact layout (K score lanes +
+            # int label in the meta lane); callers gate on
+            # aligned_mode_ok which mirrors these conditions
+            self.mc_mode = objective.mc_lane_mode()
+            assert self.mc_mode in ("prob", "score") \
+                and weight is None and learner.n <= (1 << 24) \
+                and num_class <= 127
+            self.compact = True
+            label = np.asarray(
+                objective._label_np).astype(np.int64)
+        else:
+            self.mc_mode = None
+            self.compact = bool(
+                objective.point_grad_fn() is not None
+                and weight is None and lab01
+                and learner.n <= (1 << 24)  # rid must fit 24 meta bits
+                and learner.max_bin_global <= 64
+                and all(m.num_bin <= 64
+                        for m in learner.ds.used_mappers()))
+        with_prob = self.mc_mode == "prob"
+        rec, self.wcnt, self.W, cnts, self.bits = pack_records(
             bins, label, weight, self.C, with_bag=bagged,
-            compact=self.compact)
+            compact=self.compact, num_class=num_class,
+            with_prob=with_prob)
         self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
-                                    compact=self.compact)
+                                    compact=self.compact,
+                                    num_class=num_class,
+                                    with_prob=with_prob)
         self.n = learner.n
         L = self.cfg.num_leaves
         self.S = spec_slots(L, float(getattr(self.cfg, "tpu_level_spec",
@@ -174,10 +194,14 @@ class AlignedEngine:
         rec_full = np.zeros((self.NC, self.W, self.C), np.int32)
         rec_full[:nc0] = rec
         if init_row_scores is not None:
-            sc = np.zeros(nc0 * self.C, np.float32)
-            sc[:self.n] = np.asarray(init_row_scores, np.float32)
-            rec_full[:nc0, self.lanes["score"], :] = \
-                sc.reshape(nc0, self.C).view(np.int32)
+            isc = np.asarray(init_row_scores, np.float32)
+            if isc.ndim == 1:
+                isc = isc[None, :]
+            for k in range(num_class):
+                sc = np.zeros(nc0 * self.C, np.float32)
+                sc[:self.n] = isc[k]
+                rec_full[:nc0, self.lanes["score"] + k, :] = \
+                    sc.reshape(nc0, self.C).view(np.int32)
         cnts_full = np.zeros(self.NC, np.int32)
         cnts_full[:nc0] = cnts
         self.rec = jnp.asarray(rec_full)
@@ -190,6 +214,11 @@ class AlignedEngine:
         # next dispatch gates its score update on it, so a successor of
         # an inexact tree is a guaranteed score no-op (see build())
         self._last_exact = jnp.asarray(True)
+        # multiclass deferred application: (spec, class_k, scale) of the
+        # last dispatch, applied at the start of the NEXT dispatch (or by
+        # flush_pending_apply), gated by the exactness CHAIN self._gate
+        self._mc_pending = None
+        self._gate = jnp.asarray(True)
 
     # ------------------------------------------------------------------
     def row_scores_dev(self):
@@ -223,11 +252,45 @@ class AlignedEngine:
         return rec
 
     # ------------------------------------------------------------------
-    def _build_program(self, external_grads: bool = False):
+    def _mc_payload_fn(self, class_k: int):
+        """In-kernel (g, h, bagmask) closure for multiclass class_k:
+        reads the class's PROB lane (softmax) or SCORE lane (OVA) plus
+        the meta label bits — lane indices baked in, Pallas-traceable."""
+        ln = self.lanes
+        meta_lane = ln["meta"]
+        bagged = self.bagged
+        if self.mc_mode == "prob":
+            lane = ln["prob"] + class_k
+            pg = self.objective.prob_point_grad()
+        else:
+            lane = ln["score"] + class_k
+            pg = self.objective.score_point_grad(class_k)
+
+        def fn(rows):
+            v = _f32(rows[lane, :])
+            meta = rows[meta_lane, :]
+            is_lab = ((meta >> META_LABEL) & META_LABEL_MASK) == class_k
+            g, h = pg(v, is_lab)
+            bag = (((meta >> META_BAG) & 1) != 0) if bagged else None
+            return g, h, bag
+        return fn
+
+    def _build_program(self, external_grads: bool = False,
+                       class_k: int = 0):
         """The jitted per-iteration program: gradients + speculative tree
         build. Returns (rec_final, cnts_final, AlignedSpec). With
         external_grads the g/h lanes come from row-order arrays gathered
-        by the rid lane instead of the pointwise in-lane computation."""
+        by the rid lane instead of the pointwise in-lane computation.
+
+        MULTICLASS (self.num_class > 1, one program per class_k):
+        per-class g/h lanes are written from the K score lanes FIRST
+        (pre-iteration scores, the reference's gradients-once semantics,
+        boosting gbdt.cpp:415-444), then the PREVIOUS dispatch's leaf
+        values are applied to its class lane (deferred application: the
+        valmap is defined on this program's STARTING layout), and no
+        score application happens at the end — this class's valmap
+        applies at the start of the next dispatch, or via
+        flush_pending_apply at a sync point."""
         lr = self.learner
         cfg = self.cfg
         C, NC, S = self.C, self.NC, self.S
@@ -256,8 +319,17 @@ class AlignedEngine:
         # bag: f32 lane (standard) or meta bit (-2, compact); -1 = none
         bag_lane = (-2 if self.compact else ln["bag"]) if bagged else -1
         bits = self.bits
-        bpw = bins_per_word(self.compact)
-        gfn = self._pgrad if self.compact else None
+        bpw = bins_per_word(self.compact and bits == 6)
+        K_cls = self.num_class
+        multiclass = K_cls > 1
+        # single-class compact: pointwise gradients inline in the
+        # kernels; multiclass: per-class closure over prob/score lanes
+        if multiclass:
+            gfn = self._mc_payload_fn(class_k)
+        else:
+            gfn = self._pgrad if self.compact else None
+        score_lane = ln["score"] + class_k
+        prev_lane_off = ln["score"] + ((class_k - 1) % K_cls)
         axis = lr.axis_name
         dp = axis is not None and lr.parallel_mode == "data"
 
@@ -386,8 +458,47 @@ class AlignedEngine:
         eval_all = jax.vmap(eval_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0))
 
         def build(rec, cnts_pc, feature_mask_f32, scale_in, prev_ok,
-                  g_rows=None, h_rows=None):
-            if external_grads:
+                  g_rows=None, h_rows=None, pleafI=None, pcover=None,
+                  pn_exec=None, pscale=None):
+            if multiclass:
+                # deferred application of the PREVIOUS dispatch's
+                # committed leaf values to ITS class lane: the valmap is
+                # defined on THIS program's starting layout (the prev
+                # build's final layout), gated by the exactness chain
+                pbegin = pleafI[:, LI_BEGIN]
+                pcount = pleafI[:, LI_COUNT]
+                slot_p, in_range_p = slot_in_any_map(pbegin, pcount,
+                                                     NC, C)
+                exists_p = jnp.arange(S + 1) <= pn_exec
+                in_any_p = in_range_p & exists_p[slot_p]
+                valmap_p = jnp.where(in_any_p & prev_ok,
+                                     pcover[slot_p], 0.0)
+                sc = _f32(rec[:, prev_lane_off, :]) \
+                    + valmap_p[:, None] * pscale
+                rec = rec.at[:, prev_lane_off, :].set(_i32(sc))
+                if class_k == 0 and self.mc_mode == "prob":
+                    # iteration boundary: refresh the PROB lanes from
+                    # the now-complete previous iteration's scores —
+                    # every class of this iteration derives gradients
+                    # from these pre-iteration probabilities
+                    # (gbdt.cpp:415-444 computes gradients once),
+                    # untouched by the same-iteration deferred score
+                    # applications
+                    scores = [_f32(rec[:, ln["score"] + j, :])
+                              for j in range(K_cls)]
+                    m = scores[0]
+                    for j in range(1, K_cls):
+                        m = jnp.maximum(m, scores[j])
+                    tot = jnp.zeros_like(m)
+                    exps = []
+                    for j in range(K_cls):
+                        e = jnp.exp(scores[j] - m)
+                        exps.append(e)
+                        tot = tot + e
+                    for j in range(K_cls):
+                        rec = rec.at[:, ln["prob"] + j, :].set(
+                            _i32(exps[j] / tot))
+            elif external_grads:
                 assert not self.compact, \
                     "external grads need grad lanes (standard layout)"
                 rid = jnp.clip(rec[:, ln["rid"], :], 0, self.n - 1)
@@ -407,7 +518,7 @@ class AlignedEngine:
             root_hist_all = slot_hist_pass(rec, root_slots, cnts_pc, 1,
                                            F, B, C, group, wcnt,
                                            bag_lane=bag_lane, bits=bits,
-                                           grad_fn=gfn,
+                                           grad_fn=gfn, num_class=K_cls,
                                            interpret=interpret)
             root_hist = _gsum(root_hist_all[0])
             root_g = jnp.sum(root_hist[0, :, 0])
@@ -593,7 +704,8 @@ class AlignedEngine:
                                       meta_pc, wsel_pc, hslots_pc, cbits,
                                       C, W, wcnt, K, F, B, group,
                                       bag_lane=bag_lane, bits=bits,
-                                      grad_fn=gfn, interpret=interpret)
+                                      grad_fn=gfn, num_class=K_cls,
+                                      interpret=interpret)
 
                 # ---- updated tables (begins relaid for ALL slots)
                 depth_new = leafI[:, LI_DEPTH] + 1
@@ -753,12 +865,14 @@ class AlignedEngine:
             # be discarded by the host, so prev_ok forces it to be a
             # score no-op instead of trusting it to rebuild identically
             # on the shifted physical layout)
-            exists_f = jnp.arange(S + 1) <= n_exec
-            slot_f, _, _, _, in_any_f = chunk_maps(leafI, exists_f)
             applied = exact & prev_ok
-            valmap = jnp.where(in_any_f & applied, cover[slot_f], 0.0)
-            sc = _f32(rec[:, ln["score"], :]) + valmap[:, None] * scale_in
-            rec = rec.at[:, ln["score"], :].set(_i32(sc))
+            if not multiclass:
+                exists_f = jnp.arange(S + 1) <= n_exec
+                slot_f, _, _, _, in_any_f = chunk_maps(leafI, exists_f)
+                valmap = jnp.where(in_any_f & applied, cover[slot_f], 0.0)
+                sc = _f32(rec[:, score_lane, :]) \
+                    + valmap[:, None] * scale_in
+                rec = rec.at[:, score_lane, :].set(_i32(sc))
 
             spec = AlignedSpec(rounds=rounds, n_exec=n_exec,
                                execF=execF[:Sm1],
@@ -815,6 +929,103 @@ class AlignedEngine:
         self._iter_tag += 1
         self._score_cache = None
         return spec, ncommit_dev, exact_dev, applied_dev
+
+    def _null_prev(self):
+        """A no-op 'previous spec' for the first multiclass dispatch:
+        begins at NC so no chunk is in range -> valmap is exactly 0."""
+        S = self.S
+        leafI = jnp.zeros((S, LI_W), jnp.int32).at[:, LI_BEGIN].set(
+            jnp.full((S,), self.NC, jnp.int32))
+        return leafI, jnp.zeros(S + 1, jnp.float32), jnp.int32(0), \
+            jnp.float32(0.0)
+
+    def train_iter_mc(self, class_k: int, scale: float,
+                      feature_mask: Optional[np.ndarray] = None):
+        """One multiclass class-tree build (one of K dispatches per
+        boosting iteration). Applies the PREVIOUS dispatch's leaf values
+        (deferred, exactness-chain gated) and trains class_k's tree from
+        pre-iteration scores. Returns (spec, ncommit_dev, exact_dev,
+        applied_dev) — all device values, no sync; `applied_dev` is the
+        chain gate under which this spec's values will apply."""
+        fmask = self.learner._fmask_arr(feature_mask)
+        fn = self._program(
+            ("build_mc", class_k),
+            lambda: self._build_program(class_k=class_k), donate=(0,))
+        if self._mc_pending is None:
+            pleafI, pcover, pn_exec, pscale = self._null_prev()
+        else:
+            pspec, _pk, psc = self._mc_pending
+            pleafI, pcover, pn_exec, pscale = (
+                pspec.leafI, pspec.cover, pspec.n_exec, jnp.float32(psc))
+        rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
+            self.rec, self.cnts, fmask, jnp.float32(scale), self._gate,
+            pleafI=pleafI, pcover=pcover, pn_exec=pn_exec, pscale=pscale)
+        self.rec, self.cnts = rec, cnts
+        self._gate = applied_dev          # chain: g & exact
+        self._mc_pending = (spec, class_k, scale)
+        self._iter_tag += 1
+        self._score_cache = None
+        return spec, ncommit_dev, exact_dev, applied_dev
+
+    def flush_pending_apply(self):
+        """Apply the last multiclass dispatch's deferred leaf values to
+        its class lane (sync points: metrics, fallback, end of
+        training). The undo program's valmap math is reused with the
+        sign flipped."""
+        if self._mc_pending is None:
+            return
+        spec, class_k, scale = self._mc_pending
+        self._mc_pending = None
+        fn = self._program(("apply_mc", class_k),
+                           lambda: self._undo_program(class_k=class_k,
+                                                      sign=+1.0),
+                           donate=(0,))
+        self.rec = fn(self.rec, spec.leafI, spec.cover, spec.n_exec,
+                      self._gate, jnp.float32(scale))
+        self._score_cache = None
+
+    def reset_mc(self, row_scores_kn):
+        """Fallback reset: drop any deferred application, re-ingest
+        authoritative row-order scores into ALL class lanes, reset the
+        exactness chain."""
+        self._mc_pending = None
+        for k in range(self.num_class):
+            self.set_row_scores_lane(k, row_scores_kn[k])
+        self._gate = jnp.asarray(True)
+
+    def set_row_scores_lane(self, class_k: int, row_scores):
+        fn = self._program(("setsc", class_k),
+                           lambda: self._set_scores_program(class_k),
+                           donate=(0,))
+        self.rec = fn(self.rec, jnp.asarray(row_scores, jnp.float32))
+        self._score_cache = None
+
+    def row_scores_mc_dev(self) -> jax.Array:
+        """[K, N] row-order scores as a DEVICE array (flush any
+        deferred application first so the lanes are authoritative)."""
+        self.flush_pending_apply()
+        fn = self._program("mat_mc", self._materialize_mc_program)
+        return fn(self.rec, self.cnts)
+
+    def row_scores_mc(self) -> np.ndarray:
+        return np.asarray(self.row_scores_mc_dev())
+
+    def _materialize_mc_program(self):
+        ln = self.lanes
+        n, C, K = self.n, self.C, self.num_class
+
+        def fn(rec, cnts):
+            rid = self._rid_lanes(rec).reshape(-1)
+            pos = jnp.arange(C, dtype=jnp.int32)
+            valid = (pos[None, :] < cnts[:, None]).reshape(-1)
+            rid = jnp.where(valid & (rid < n), rid, n)
+            outs = []
+            for k in range(K):
+                sc = _f32(rec[:, ln["score"] + k, :]).reshape(-1)
+                outs.append(
+                    jnp.zeros(n + 1, jnp.float32).at[rid].set(sc)[:n])
+            return jnp.stack(outs)
+        return fn
 
     def apply_spec_to_scores(self, score, vbins, spec, applied, scale):
         """score [Nv] += scale * committed_tree(vbins) ON DEVICE — the
@@ -892,9 +1103,11 @@ class AlignedEngine:
         self._score_cache = None
         self._last_exact = jnp.asarray(True)
 
-    def _undo_program(self):
+    def _undo_program(self, class_k: int = 0, sign: float = -1.0):
+        """Subtract (sign=-1, the undo) or add (sign=+1, the multiclass
+        deferred apply) a spec's gated valmap to class_k's score lane."""
         C, NC, S = self.C, self.NC, self.S
-        ln = self.lanes
+        lane = self.lanes["score"] + class_k
 
         def fn(rec, leafI, cover, n_exec, applied, scale):
             begin = leafI[:, LI_BEGIN]
@@ -903,8 +1116,8 @@ class AlignedEngine:
             exists = jnp.arange(leafI.shape[0]) <= n_exec
             in_any = in_range & exists[slot_of]
             valmap = jnp.where(in_any & applied, cover[slot_of], 0.0)
-            sc = _f32(rec[:, ln["score"], :]) - valmap[:, None] * scale
-            return rec.at[:, ln["score"], :].set(_i32(sc))
+            sc = _f32(rec[:, lane, :]) + valmap[:, None] * (sign * scale)
+            return rec.at[:, lane, :].set(_i32(sc))
         return fn
 
     def set_bag(self, mask_rows):
@@ -924,8 +1137,9 @@ class AlignedEngine:
                 rid = jnp.clip(meta & META_RID_MASK, 0, n)
                 vals = jnp.concatenate(
                     [mask, jnp.zeros(1, jnp.float32)])[rid]
-                meta = (meta & ~(1 << META_BAG)) | (
-                    (vals > 0.5).astype(jnp.int32) << META_BAG)
+                # bag bit is the SIGN bit (31): int32-safe clear + set
+                meta = (meta & jnp.int32(0x7FFFFFFF)) | jnp.where(
+                    vals > 0.5, jnp.int32(-(1 << 31)), jnp.int32(0))
                 return rec.at[:, ln["meta"], :].set(meta)
             rid = jnp.clip(rec[:, ln["rid"], :], 0, n)
             vals = jnp.concatenate([mask, jnp.zeros(1, jnp.float32)])[rid]
@@ -935,9 +1149,7 @@ class AlignedEngine:
     def set_row_scores(self, row_scores):
         """Re-ingest ROW-order scores into the score lane (leaf-wise
         fallback path: the fallback tree updated scores in row order)."""
-        fn = self._program("setsc", self._set_scores_program, donate=(0,))
-        self.rec = fn(self.rec, jnp.asarray(row_scores, jnp.float32))
-        self._score_cache = None
+        self.set_row_scores_lane(0, row_scores)
         self._last_exact = jnp.asarray(True)   # lane is authoritative again
 
     def _rid_lanes(self, rec):
@@ -947,14 +1159,14 @@ class AlignedEngine:
             return rec[:, ln["meta"], :] & META_RID_MASK
         return rec[:, ln["rid"], :]
 
-    def _set_scores_program(self):
+    def _set_scores_program(self, class_k: int = 0):
         n = self.n
-        ln = self.lanes
+        lane = self.lanes["score"] + class_k
 
         def fn(rec, scores):
             rid = jnp.clip(self._rid_lanes(rec), 0, n - 1)
             vals = scores[rid]
-            return rec.at[:, ln["score"], :].set(_i32(vals))
+            return rec.at[:, lane, :].set(_i32(vals))
         return fn
 
     def row_scores(self) -> np.ndarray:

@@ -885,24 +885,31 @@ class DeviceTreeLearner:
                 and self.cfg.num_leaves >= 2
                 and self.max_bin_global <= 256
                 and objective is not None
-                and objective.num_model_per_iteration == 1
+                and (objective.num_model_per_iteration == 1
+                     # multiclass rides K score lanes + lane-wise
+                     # in-program gradients (compact layout only)
+                     or (objective.num_model_per_iteration <= 127
+                         and objective.mc_lane_mode() is not None))
                 # non-pointwise objectives pay a row-order gradient
                 # round-trip (materialize + gather ~100ms); worth it only
                 # when the tree build dominates
                 and (objective.point_grad_fn() is not None
+                     or objective.num_model_per_iteration > 1
                      or self.n >= 4_000_000))
 
     def aligned_engine(self, objective, init_row_scores=None,
-                       bagged=False):
+                       bagged=False, num_class=1):
         """The persistent AlignedEngine for (this learner, objective)."""
         eng = getattr(self, "_aligned_eng", None)
         if eng is None or eng.objective is not objective \
-                or getattr(eng, "bagged", False) != bagged:
+                or getattr(eng, "bagged", False) != bagged \
+                or getattr(eng, "num_class", 1) != num_class:
             from .aligned_builder import AlignedEngine
             eng = AlignedEngine(
                 self, objective,
                 interpret=bool(self.cfg.tpu_aligned_interpret),
-                init_row_scores=init_row_scores, bagged=bagged)
+                init_row_scores=init_row_scores, bagged=bagged,
+                num_class=num_class)
             self._aligned_eng = eng
         return eng
 

@@ -84,10 +84,12 @@ def aligned_num_chunks(n: int, cfg, spec_slots: int) -> int:
     return (n + C - 1) // C + spec_slots + 2
 
 
-# compact meta-lane bit layout: rid | label << 24 | bag << 25
+# compact meta-lane bit layout: rid | label << 24 (7 bits: 0/1 binary
+# label, or the integer class id for multiclass, K <= 127) | bag << 31
 META_RID_MASK = (1 << 24) - 1
 META_LABEL = 24
-META_BAG = 25
+META_LABEL_MASK = 127
+META_BAG = 31
 
 
 def bins_per_word(compact: bool) -> int:
@@ -101,19 +103,32 @@ def _bpw_for_bits(bits: int) -> int:
     return bins_per_word(bits == 6)
 
 
-def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False):
+def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
+                num_class: int = 1, with_prob: bool = False):
     """(lane indices, padded W) for a record with `wcnt` bin words.
 
-    COMPACT layout (pointwise objectives with 0/1 labels, unweighted,
-    n <= 2^24): bin words + score + meta, where meta packs
-    rid | label << 24 | bag << 25 — gradients are recomputed in-kernel
-    from (score, label) instead of riding as lanes, halving the record
-    (W 16 -> 8 at HIGGS shape) and with it every DMA and the route
-    matmul of the move pass."""
+    COMPACT layout (lane-wise objectives with small-integer labels,
+    unweighted, n <= 2^24): bin words + num_class score lanes + meta,
+    where meta packs rid | label << 24 | bag << 31 — gradients are
+    recomputed in-kernel from (scores, label) instead of riding as
+    lanes, halving the record (W 16 -> 8 at HIGGS shape) and with it
+    every DMA and the route matmul of the move pass. `score` is the
+    FIRST of the num_class score lanes (class k at score + k)."""
     ls = wcnt
     if compact:
-        lanes = dict(score=ls, meta=ls + 1)
-        w = wcnt + 2
+        lanes = dict(score=ls)
+        w = wcnt + num_class
+        if with_prob:
+            # softmax multiclass: per-class PROBABILITY lanes, written
+            # once per iteration from the pre-iteration score lanes (the
+            # reference computes gradients once then trains K trees,
+            # gbdt.cpp:415-444); class gradients derive lane-locally
+            # from p_k, immune to the same-iteration deferred score
+            # applications
+            lanes["prob"] = w
+            w += num_class
+        lanes["meta"] = w
+        w += 1
     else:
         lanes = dict(score=ls, label=ls + 1, grad=ls + 2, hess=ls + 3,
                      rid=ls + 4, weight=ls + 5)
@@ -127,32 +142,36 @@ def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False):
 
 def pack_records(bins: np.ndarray, label: np.ndarray,
                  weight, chunk: int, with_bag: bool = False,
-                 compact: bool = False):
+                 compact: bool = False, num_class: int = 1,
+                 with_prob: bool = False):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
     rows in chunk i (C except the last).
     """
     n, f = bins.shape
-    bpw = bins_per_word(compact)
+    # compact 6-bit packing only holds bins < 64; 8-bit compact records
+    # (multiclass at max_bin 255) keep 4/word with the meta layout
+    bits = 6 if (compact and bins.max(initial=0) < 64) else 8
+    bpw = bins_per_word(compact and bits == 6)
     wcnt = (f + bpw - 1) // bpw
-    lanes, w_pad = lane_layout(wcnt, with_bag, compact)
+    lanes, w_pad = lane_layout(wcnt, with_bag, compact, num_class,
+                               with_prob)
     nc = (n + chunk - 1) // chunk
     n_pad = nc * chunk
     padded = np.zeros((n_pad, wcnt * bpw), np.uint8)
     padded[:n, :f] = bins
     words = padded.reshape(n_pad, wcnt, bpw).astype(np.uint32)
-    if compact:
-        packed = np.zeros((n_pad, wcnt), np.uint32)
-        for i in range(bpw):
-            packed |= words[:, :, i] << (6 * i)
-    else:
-        packed = (words[:, :, 0] | (words[:, :, 1] << 8)
-                  | (words[:, :, 2] << 16) | (words[:, :, 3] << 24))
+    packed = np.zeros((n_pad, wcnt), np.uint32)
+    for i in range(bpw):
+        packed |= words[:, :, i] << (bits * i)
     rec = np.zeros((n_pad, w_pad), np.int32)
     rec[:, :wcnt] = packed.astype(np.int64).astype(np.int32)
     if compact:
-        lab = (np.asarray(label) > 0).astype(np.int64)
+        if num_class > 1:
+            lab = np.asarray(label).astype(np.int64) & META_LABEL_MASK
+        else:
+            lab = (np.asarray(label) > 0).astype(np.int64)
         meta = np.arange(n_pad, dtype=np.int64)
         meta[:n] |= lab << META_LABEL
         meta[:n] |= 1 << META_BAG     # all rows in-bag initially
@@ -171,7 +190,7 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
         rec.reshape(nc, chunk, w_pad).transpose(0, 2, 1))
     cnts = np.full(nc, chunk, np.int32)
     cnts[-1] = n - (nc - 1) * chunk
-    return rec3, wcnt, w_pad, cnts
+    return rec3, wcnt, w_pad, cnts, bits
 
 
 # ---------------------------------------------------------------------------
@@ -219,17 +238,27 @@ def _cat_word(cbits_ref, ks, binv):
 
 
 
-def _payload_gh(rows, nvalid, chunk, wcnt, grad_fn, bag_lane):
+def _payload_gh(rows, nvalid, chunk, wcnt, grad_fn, bag_lane,
+                num_class=1):
     """(g, h, take) for a [W, C] row block: lane-resident gradients
-    (standard layout) or recomputed in-kernel from (score, label)
-    (compact layout, grad_fn not None — the objective's pointwise
-    gradient inlined into the Pallas kernel)."""
+    (standard layout, or multiclass compact where per-class g/h were
+    written from pre-iteration scores) or recomputed in-kernel
+    (single-class compact, grad_fn not None — the objective's pointwise
+    gradient inlined into the Pallas kernel). bag_lane: >= 0 an f32 0/1
+    lane, -2 the meta-lane bag BIT, -1 none."""
     posh = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
     take = posh < nvalid
-    if grad_fn is not None:
-        score = lax.bitcast_convert_type(rows[wcnt, :], jnp.float32)
+    if grad_fn is not None and num_class > 1:
+        # multiclass: engine-built closure with lane indices baked in,
+        # reading the class's prob/score lane + the meta label bits
+        g, h, bagmask = grad_fn(rows)
+        if bag_lane == -2 and bagmask is not None:
+            take = take & bagmask
+    elif grad_fn is not None:
         meta = rows[wcnt + 1, :]
-        label = ((meta >> META_LABEL) & 1).astype(jnp.float32)
+        score = lax.bitcast_convert_type(rows[wcnt, :], jnp.float32)
+        label = ((meta >> META_LABEL) & META_LABEL_MASK) \
+            .astype(jnp.float32)
         g, h = grad_fn(score, label, None)
         if bag_lane == -2:     # compact bagging: bag bit masks stats
             take = take & (((meta >> META_BAG) & 1) != 0)
@@ -264,7 +293,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  out_ref, hist_ref, stag,
                  fbuf, hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
                  num_features, b_pad, group, dummy, bag_lane,
-                 bits, grad_fn):
+                 bits, grad_fn, num_class):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
@@ -337,7 +366,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         buffers hold the side's rows COMPACTED, so the one-hot work runs
         at full density on exactly the smaller child's rows. Bagged
         stats cover IN-BAG rows only (gbdt.cpp:209-275)."""
-        g, h, take = _payload_gh(rows, nvalid, C, wcnt, grad_fn, bag_lane)
+        g, h, take = _payload_gh(rows, nvalid, C, wcnt, grad_fn,
+                                 bag_lane, num_class)
         gm = jnp.where(take, g, 0.0)
         hm = jnp.where(take, h, 0.0)
         cntp = take.astype(jnp.float32)
@@ -500,10 +530,11 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
-    "group", "bag_lane", "bits", "grad_fn", "interpret"))
+    "group", "bag_lane", "bits", "grad_fn", "num_class", "interpret"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
               chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
-              bag_lane=-1, bits=8, grad_fn=None, interpret=False):
+              bag_lane=-1, bits=8, grad_fn=None, num_class=1,
+              interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -533,7 +564,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
                                wcnt=wcnt, num_features=num_features,
                                b_pad=b_pad, group=group, dummy=dummy,
                                bag_lane=bag_lane, bits=bits,
-                               grad_fn=grad_fn)
+                               grad_fn=grad_fn, num_class=num_class)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     # copy chunks SKIP the blocked fetch: the block index carries the
@@ -666,7 +697,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
 # ---------------------------------------------------------------------------
 def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
                       num_features, b_pad, group, chunk, wcnt, dummy,
-                      bag_lane, bits, grad_fn):
+                      bag_lane, bits, grad_fn, num_class):
     i = pl.program_id(0)
     bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
@@ -680,7 +711,8 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
         rec = rec_ref[0]                              # [W, C]
         ks = slots_ref[i]
         g, h, valid = _payload_gh(rec, meta_ref[i] & ((1 << 20) - 1),
-                                  chunk, wcnt, grad_fn, bag_lane)
+                                  chunk, wcnt, grad_fn, bag_lane,
+                                  num_class)
         gm = jnp.where(valid, g, 0.0)
         hm = jnp.where(valid, h, 0.0)
         cnt = valid.astype(jnp.float32)
@@ -705,10 +737,10 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
-    "bag_lane", "bits", "grad_fn", "interpret"))
+    "bag_lane", "bits", "grad_fn", "num_class", "interpret"))
 def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
                    chunk, group, wcnt, bag_lane=-1, bits=8, grad_fn=None,
-                   interpret=False):
+                   num_class=1, interpret=False):
     """hist[num_slots, F, b_pad, 3] over the record matrix.
 
     slots[i] maps chunk i to its accumulation slot (a COMPACT id —
@@ -724,7 +756,8 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
     kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
                                b_pad=b_pad, group=group, chunk=chunk,
                                wcnt=wcnt, dummy=dummy, bag_lane=bag_lane,
-                               bits=bits, grad_fn=grad_fn)
+                               bits=bits, grad_fn=grad_fn,
+                               num_class=num_class)
     w_pad = records.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

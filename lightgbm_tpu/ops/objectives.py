@@ -96,6 +96,26 @@ class ObjectiveFunction:
             return g, h
         return fn
 
+    def mc_lane_mode(self):
+        """How a K-class objective's per-class gradients read the
+        aligned record (the engine's in-kernel multiclass hook):
+        "prob" — from a per-class PROBABILITY lane written once per
+        iteration from pre-iteration scores (softmax: cross-class
+        coupling lives in the prob computation); "score" — from the
+        class's own score lane (OVA: no cross-class coupling); None —
+        not lane-wise (single-class, weighted)."""
+        return None
+
+    def prob_point_grad(self):
+        """mc_lane_mode()=="prob": elementwise (p_k, is_label_k) ->
+        (g, h), Pallas-traceable."""
+        return None
+
+    def score_point_grad(self, k: int):
+        """mc_lane_mode()=="score": elementwise (s_k, is_label_k) ->
+        (g, h) for class k, Pallas-traceable."""
+        return None
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -480,6 +500,21 @@ class MulticlassSoftmax(ObjectiveFunction):
             h = h * self.weight[None, :]
         return g, h
 
+    def mc_lane_mode(self):
+        """Softmax couples classes through p = softmax(s)
+        (multiclass_objective.hpp:77-97): the engine writes per-class
+        PROB lanes once per iteration from pre-iteration scores, so
+        per-class gradients stay lane-local. Unweighted only (weights
+        would need a weight lane the compact record does not carry)."""
+        return None if self.weight is not None else "prob"
+
+    def prob_point_grad(self):
+        def fn(pk, is_label):
+            g = pk - is_label.astype(pk.dtype)
+            h = 2.0 * pk * (1.0 - pk)
+            return g, h
+        return fn
+
     def boost_from_score(self, class_id):
         # avg_output = log(class prob) (multiclass_objective.hpp:118-126)
         return math.log(max(self._class_init_probs[class_id], 1e-300))
@@ -518,6 +553,25 @@ class MulticlassOVA(ObjectiveFunction):
             gs.append(g[0])
             hs.append(h[0])
         return jnp.stack(gs), jnp.stack(hs)
+
+    def mc_lane_mode(self):
+        """One-vs-all: class k's binary logloss reads ONLY its own
+        score lane (multiclass_objective.hpp:160-199) — no cross-class
+        coupling, so gradients come straight from the score lane."""
+        return None if self.weight is not None else "score"
+
+    def score_point_grad(self, k):
+        b = self._binary[k]
+        sig = float(b.cfg.sigmoid)
+        wp, wn = b._w_pos, b._w_neg
+
+        def fn(sk, is_label):
+            sl = jnp.where(is_label, 1.0, -1.0)
+            lw = jnp.where(is_label, wp, wn)
+            response = -sl * sig / (1.0 + jnp.exp(sl * sig * sk))
+            absr = jnp.abs(response)
+            return response * lw, absr * (sig - absr) * lw
+        return fn
 
     def boost_from_score(self, class_id):
         return self._binary[class_id].boost_from_score(0)

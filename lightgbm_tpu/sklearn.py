@@ -15,6 +15,29 @@ import numpy as np
 from .basic import Booster, Dataset, LightGBMError
 from .engine import train
 
+try:
+    from sklearn.base import BaseEstimator as _SKBase
+    from sklearn.base import ClassifierMixin as _SKClassifier
+    from sklearn.base import RegressorMixin as _SKRegressor
+    _HAS_SKLEARN = True
+except Exception:  # pragma: no cover - sklearn optional
+    _SKBase = object
+
+    class _SKClassifier:  # type: ignore
+        pass
+
+    class _SKRegressor:  # type: ignore
+        pass
+    _HAS_SKLEARN = False
+
+# the conformance validation helpers need sklearn >= 1.6 (validate_data
+# with ensure_all_finite); older versions keep the permissive pre-1.6
+# behavior rather than crashing every fit/predict
+try:
+    from sklearn.utils.validation import validate_data as _sk_validate_data
+except Exception:  # pragma: no cover - old sklearn
+    _sk_validate_data = None
+
 
 class _ObjectiveFunctionWrapper:
     """Wrap sklearn-style fobj(y_true, y_pred) into engine fobj
@@ -53,7 +76,7 @@ class _EvalFunctionWrapper:
         return self.func(labels, preds)
 
 
-class LGBMModel:
+class LGBMModel(_SKBase):
     """Base sklearn estimator (reference sklearn.py:187+)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -128,6 +151,48 @@ class LGBMModel:
             self._other_params[key] = value
         return self
 
+    # sklearn conformance (check_estimator; reference
+    # tests/python_package_test/test_sklearn.py:202) ---------------------
+    def __sklearn_is_fitted__(self) -> bool:
+        return self._Booster is not None
+
+    if _HAS_SKLEARN:
+        def __sklearn_tags__(self):
+            tags = super().__sklearn_tags__()
+            tags.input_tags.allow_nan = True    # NaN = missing value
+            tags.input_tags.sparse = True       # CSR/CSC ingest
+            return tags
+
+    def _sk_validate_fit(self, X, y, classifier: bool = False):
+        """sklearn-style input validation (sets n_features_in_, rejects
+        complex/empty/inf input). DataFrames skip it to preserve the
+        categorical-dtype handling; y stays as given for ranking."""
+        if _sk_validate_data is None or hasattr(X, "columns"):
+            self.n_features_in_ = np.asarray(X).shape[1]
+            return X, np.asarray(y).reshape(-1)
+        X, y = _sk_validate_data(self, X, y,
+                                 accept_sparse=["csr", "csc"],
+                                 ensure_all_finite="allow-nan",
+                                 dtype=np.float64, multi_output=False)
+        if classifier:
+            from sklearn.utils.multiclass import check_classification_targets
+            check_classification_targets(y)
+        return X, y
+
+    def _sk_validate_predict(self, X):
+        if not _HAS_SKLEARN:
+            return X
+        from sklearn.exceptions import NotFittedError
+        if self._Booster is None:
+            raise NotFittedError(
+                "This estimator is not fitted yet. Call 'fit' first.")
+        if _sk_validate_data is None or hasattr(X, "columns") \
+                or isinstance(X, str):
+            return X
+        return _sk_validate_data(self, X, accept_sparse=["csr", "csc"],
+                                 ensure_all_finite="allow-nan",
+                                 dtype=np.float64, reset=False)
+
     # ------------------------------------------------------------------
     def _make_train_params(self) -> Dict[str, Any]:
         params = self.get_params()
@@ -186,6 +251,8 @@ class LGBMModel:
         params = self._make_train_params()
         if eval_metric is not None and not callable(eval_metric):
             params["metric"] = eval_metric
+        if not getattr(self, "_sk_prevalidated", False):
+            X, y = self._sk_validate_fit(X, y)
         y = np.asarray(y).reshape(-1)
         sample_weight = self._sample_weight_with_class_weight(y, sample_weight)
         train_set = Dataset(X, label=y, weight=sample_weight, group=group,
@@ -219,13 +286,16 @@ class LGBMModel:
             early_stopping_rounds=early_stopping_rounds,
             evals_result=self._evals_result, verbose_eval=verbose,
             callbacks=callbacks)
-        self._n_features = np.asarray(X).shape[1]
+        self._n_features = (X.shape[1] if hasattr(X, "shape")
+                            else np.asarray(X).shape[1])
+        self.n_features_in_ = self._n_features
         self._best_iteration = self._Booster.best_iteration
         self._best_score = self._Booster.best_score
         return self
 
     def predict(self, X, raw_score=False, num_iteration=None,
                 pred_leaf=False, pred_contrib=False, **kwargs):
+        X = self._sk_validate_predict(X)   # raises NotFittedError
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted")
         return self._Booster.predict(X, raw_score=raw_score,
@@ -265,7 +335,7 @@ class LGBMModel:
         return self._objective
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_SKRegressor, LGBMModel):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if self._objective is None:
@@ -276,8 +346,10 @@ class LGBMRegressor(LGBMModel):
         return self
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_SKClassifier, LGBMModel):
     def fit(self, X, y, **kwargs) -> "LGBMClassifier":
+        X, y = self._sk_validate_fit(X, y, classifier=True)
+        self._sk_prevalidated = True
         y = np.asarray(y).reshape(-1)
         self._classes, y_enc = np.unique(y, return_inverse=True)
         self._n_classes = len(self._classes)
@@ -296,7 +368,10 @@ class LGBMClassifier(LGBMModel):
             kwargs["eval_set"] = [
                 (vx, np.asarray([label_map[v] for v in np.asarray(vy)]))
                 for vx, vy in es]
-        super().fit(X, y_enc.astype(np.float64), **kwargs)
+        try:
+            super().fit(X, y_enc.astype(np.float64), **kwargs)
+        finally:
+            self._sk_prevalidated = False
         return self
 
     def predict(self, X, raw_score=False, num_iteration=None,

@@ -1,0 +1,46 @@
+"""sklearn check_estimator conformance (reference
+tests/python_package_test/test_sklearn.py:202 sklearn integration;
+VERDICT r3 Missing #6). The full battery trains ~50 models per
+estimator, so it rides the slow tier."""
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.base import clone, is_classifier, is_regressor  # noqa: E402
+from sklearn.exceptions import NotFittedError  # noqa: E402
+from sklearn.utils.estimator_checks import check_estimator  # noqa: E402
+
+
+def _run(est):
+    res = check_estimator(est, on_fail=None)
+    bad = [r for r in res if str(r["status"]) == "failed"]
+    msgs = [f"{r['check_name']}: {str(r.get('exception'))[:200]}"
+            for r in bad]
+    assert not bad, "\n".join(msgs)
+
+
+def test_check_estimator_classifier():
+    _run(lgb.LGBMClassifier(verbosity=-1, min_child_samples=5))
+
+
+def test_check_estimator_regressor():
+    _run(lgb.LGBMRegressor(verbosity=-1, min_child_samples=5))
+
+
+def test_clone_and_type_predicates():
+    c = lgb.LGBMClassifier(num_leaves=9, verbosity=-1)
+    r = lgb.LGBMRegressor(num_leaves=9, verbosity=-1)
+    assert is_classifier(c) and not is_regressor(c)
+    assert is_regressor(r) and not is_classifier(r)
+    c2 = clone(c)
+    assert c2.get_params()["num_leaves"] == 9
+    assert c2 is not c
+
+
+def test_unfitted_predict_raises_notfitted():
+    import numpy as np
+    with pytest.raises(NotFittedError):
+        lgb.LGBMClassifier().predict(np.zeros((3, 2)))

@@ -58,7 +58,7 @@ def main():
     B = MB + 1 if MB % 2 else MB
 
     for C in CS:
-        rec_np, wcnt, W, cnts = pack_records(bins, label, None, C)
+        rec_np, wcnt, W, cnts, _bits = pack_records(bins, label, None, C)
         nc_data = rec_np.shape[0]
         NC = nc_data + 4
         fullr = np.zeros((NC, W, C), np.int32)

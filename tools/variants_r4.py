@@ -43,7 +43,7 @@ def main():
     B = MB + 1 if MB % 2 else MB
 
     for C in (512, 1024, 2048):
-        rec_np, wcnt, W, cnts = pack_records(bins, label, None, C)
+        rec_np, wcnt, W, cnts, _bits = pack_records(bins, label, None, C)
         nc_data = rec_np.shape[0]
         NC = nc_data + 4
         full = np.zeros((NC, W, C), np.int32)
