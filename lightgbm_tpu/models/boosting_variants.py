@@ -29,37 +29,59 @@ class GOSS(GBDT):
             raise ValueError("top_rate + other_rate must be <= 1.0")
         if cfg.top_rate <= 0.0 or cfg.other_rate <= 0.0:
             raise ValueError("top_rate and other_rate must be positive")
-        self._goss_multiplier: Optional[np.ndarray] = None
+        self._goss_multiplier = None     # device [N] or None
+        self._goss_select_fn = None
 
     def _bagging(self, iter_idx: int) -> None:
         """goss.hpp:141-160: no subsampling during the first
-        1/learning_rate iterations."""
+        1/learning_rate iterations. The selection runs ON DEVICE
+        (|g*h| ranking, threshold, uniform-key sampling of the rest) —
+        only the final [N] keep-mask is pulled for the host-side
+        partition indices, not the 2xN float gradient arrays."""
         cfg = self.cfg
         self._goss_multiplier = None
         if iter_idx < int(1.0 / cfg.learning_rate):
             self.bag_data_indices = None
             self.bag_data_cnt = self.num_data
             return
-        # |g*h| summed over classes (goss.hpp:96-101)
-        g = np.abs(np.asarray(self._cur_grad) * np.asarray(self._cur_hess)
-                   ).sum(axis=0)
         n = self.num_data
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
-        threshold = np.partition(g, n - top_k)[n - top_k]
-        big = g >= threshold
-        rest_idx = np.nonzero(~big)[0]
-        take = self._bag_rng.choice(len(rest_idx),
-                                    min(other_k, len(rest_idx)),
-                                    replace=False)
-        sampled = rest_idx[take]
-        sel = np.sort(np.concatenate([np.nonzero(big)[0], sampled]))
+        # per-iteration device key drawn from the bagging RNG stream so
+        # runs stay reproducible under bagging_seed
+        seed = int(self._bag_rng.randint(0, 2**31 - 1))
+        fn = self._goss_select_fn
+        if fn is None:
+            multiply = (n - top_k) / other_k
+
+            def select(g, h, seed_arr):
+                # |g*h| summed over classes (goss.hpp:96-101)
+                a = jnp.abs(g * h).sum(axis=0)
+                s = jnp.sort(a)
+                threshold = s[n - top_k]
+                big = a >= threshold
+                # without-replacement sample of the rest: the other_k
+                # smallest uniform keys among non-big rows, row-index
+                # tie-broken (f32 keys collide ~every other iteration
+                # at 10M rows) so exactly other_k are taken
+                u = jax.random.uniform(jax.random.PRNGKey(seed_arr[0]),
+                                       (n,))
+                # order keys as (u, row) pairs via a stable argsort rank
+                order = jnp.argsort(jnp.where(big, 2.0, u), stable=True)
+                rank = jnp.zeros(n, jnp.int32).at[order].set(
+                    jnp.arange(n, dtype=jnp.int32))
+                sampled = (~big) & (rank < other_k)
+                mask = big | sampled
+                mult = jnp.where(sampled, jnp.float32(multiply), 1.0)
+                return mask, mult
+            fn = jax.jit(select)
+            self._goss_select_fn = fn
+        mask_dev, mult_dev = fn(self._cur_grad, self._cur_hess,
+                                jnp.asarray([seed], jnp.uint32))
+        sel = np.nonzero(np.asarray(mask_dev))[0]
         self.bag_data_indices = sel.astype(np.int32)
         self.bag_data_cnt = len(sel)
-        multiply = (n - top_k) / other_k
-        mult = np.ones(n, np.float32)
-        mult[sampled] = multiply
-        self._goss_multiplier = mult
+        self._goss_multiplier = mult_dev
 
     def _post_bagging_gradients(self, gdev, hdev):
         if self._goss_multiplier is None:

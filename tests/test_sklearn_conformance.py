@@ -23,11 +23,13 @@ def _run(est):
 
 
 def test_check_estimator_classifier():
-    _run(lgb.LGBMClassifier(verbosity=-1, min_child_samples=5))
+    _run(lgb.LGBMClassifier(verbosity=-1, min_child_samples=5,
+         n_estimators=40, num_leaves=15))
 
 
 def test_check_estimator_regressor():
-    _run(lgb.LGBMRegressor(verbosity=-1, min_child_samples=5))
+    _run(lgb.LGBMRegressor(verbosity=-1, min_child_samples=5,
+         n_estimators=40, num_leaves=15))
 
 
 def test_clone_and_type_predicates():
