@@ -365,7 +365,8 @@ class Booster:
         self._valid_sets_public: List["Dataset"] = []
         self.name_train_set = "training"
         if model_file is not None:
-            with open(model_file) as fh:
+            from .io.file_io import open_file
+            with open_file(model_file) as fh:
                 self._init_from_string(fh.read())
         elif model_str is not None:
             self._init_from_string(model_str)
@@ -567,7 +568,8 @@ class Booster:
             nf_model = (self._gbdt.train_data.num_total_features
                         if self._gbdt is not None else
                         self._loaded.get("max_feature_idx", -2) + 1)
-            with open(data, errors="replace") as f:
+            from .io.file_io import open_file
+            with open_file(data, errors="replace") as f:
                 if cfg.header:
                     f.readline()
                 first = f.readline()
@@ -731,7 +733,8 @@ class Booster:
         return obj.name + extras.get(obj.name, lambda o: "")(obj)
 
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
-        with open(filename, "w") as fh:
+        from .io.file_io import open_file
+        with open_file(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration))
         return self
 

@@ -709,7 +709,8 @@ class Dataset:
             "has_query": self.metadata.query_boundaries is not None,
             "has_init_score": self.metadata.init_score is not None,
         }
-        with open(path, "wb") as fh:
+        from .file_io import open_file
+        with open_file(path, "wb") as fh:
             fh.write(_BINARY_MAGIC)
             hb = json.dumps(header).encode()
             fh.write(struct.pack("<q", len(hb)))
@@ -724,7 +725,8 @@ class Dataset:
 
     @classmethod
     def load_binary(cls, path: str) -> "Dataset":
-        with open(path, "rb") as fh:
+        from .file_io import open_file
+        with open_file(path, "rb") as fh:
             magic = fh.read(len(_BINARY_MAGIC))
             if magic != _BINARY_MAGIC:
                 raise ValueError(f"{path} is not a tpu_gbdt binary dataset")

