@@ -156,7 +156,8 @@ class Application:
             pred_contrib=cfg.predict_contrib)
         out = cfg.output_result or "LightGBM_predict_result.txt"
         arr = np.atleast_1d(np.asarray(preds))
-        with open(out, "w") as f:
+        from .io.file_io import open_file
+        with open_file(out, "w") as f:
             if arr.ndim == 1:
                 for v in arr:
                     f.write(f"{v:g}\n")
@@ -176,7 +177,8 @@ class Application:
         code = model_to_if_else(booster.trees,
                                 booster.num_tree_per_iteration,
                                 average_output=booster._is_average_output())
-        with open(out, "w") as f:
+        from .io.file_io import open_file
+        with open_file(out, "w") as f:
             f.write(code)
         print(f"Finished converting model. Code saved to {out}")
 

@@ -41,7 +41,10 @@ def _fsspec_open(path: str, mode: str, **kw):
     try:
         import fsspec
     except Exception:
-        raise FileNotFoundError(
+        # NOT FileNotFoundError: a missing backend is a configuration
+        # error and must not be mistaken for a missing file (exists()
+        # maps only FileNotFoundError to False)
+        raise RuntimeError(
             f"path {path!r} uses a remote filesystem scheme but no opener "
             f"is registered for it (register_filesystem) and fsspec is "
             f"not installed")

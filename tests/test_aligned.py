@@ -62,6 +62,20 @@ def test_aligned_matches_leafwise_binary():
         np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5)
 
 
+def test_aligned_matches_leafwise_255bin():
+    """max_bin=255 exercises the NIBBLE histogram factorization
+    (b_pad=256: hi/lo 4-bit one-hots instead of a 256-row one-hot)."""
+    X, y = _make()
+    a = _train(X, y, "aligned", extra={"max_bin": 255})
+    b = _train(X, y, "leafwise", extra={"max_bin": 255})
+    ta, tb = _tree_tuples(a), _tree_tuples(b)
+    assert len(ta) == len(tb)
+    for (fa, tha, va), (fb, thb, vb) in zip(ta, tb):
+        assert fa == fb
+        assert tha == thb
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5)
+
+
 def test_aligned_matches_leafwise_regression():
     X, y = _make()
     y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + y
