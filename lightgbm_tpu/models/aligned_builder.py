@@ -37,7 +37,7 @@ from jax import lax
 
 from ..ops.aligned import (META_BAG, META_LABEL, META_LABEL_MASK,
                            META_RID_MASK, R_CAT,
-                           R_COPY, R_DL, R_MT, R_SHIFT, bins_per_word,
+                           R_COPY, R_DL, R_MT, R_SHIFT, _bpw_for_bits,
                            count_pass, lane_layout, move_pass,
                            pack_records, slot_hist_pass)
 from ..ops.histogram import NUM_HIST_STATS
@@ -319,7 +319,7 @@ class AlignedEngine:
         # bag: f32 lane (standard) or meta bit (-2, compact); -1 = none
         bag_lane = (-2 if self.compact else ln["bag"]) if bagged else -1
         bits = self.bits
-        bpw = bins_per_word(self.compact and bits == 6)
+        bpw = _bpw_for_bits(bits)
         K_cls = self.num_class
         multiclass = K_cls > 1
         # single-class compact: pointwise gradients inline in the

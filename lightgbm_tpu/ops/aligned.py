@@ -92,15 +92,12 @@ META_LABEL_MASK = 127
 META_BAG = 31
 
 
-def bins_per_word(compact: bool) -> int:
-    """COMPACT records pack 5 six-bit bins per word (max_bin <= 64, the
-    reference's 4-bit dense_nbits_bin.hpp:42 analogue at TPU-natural
-    width); standard records pack 4 eight-bit bins."""
-    return 5 if compact else 4
-
-
 def _bpw_for_bits(bits: int) -> int:
-    return bins_per_word(bits == 6)
+    """Bins per 32-bit word at a given bin bit-width: COMPACT records
+    pack 8 four-bit bins (max_bin <= 16, the reference's
+    dense_nbits_bin.hpp:42 2-bins/byte analogue) or 5 six-bit bins
+    (max_bin <= 64); standard records pack 4 eight-bit bins."""
+    return {4: 8, 6: 5, 8: 4}[bits]
 
 
 def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
@@ -150,10 +147,17 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     rows in chunk i (C except the last).
     """
     n, f = bins.shape
-    # compact 6-bit packing only holds bins < 64; 8-bit compact records
-    # (multiclass at max_bin 255) keep 4/word with the meta layout
-    bits = 6 if (compact and bins.max(initial=0) < 64) else 8
-    bpw = bins_per_word(compact and bits == 6)
+    # compact packing at the narrowest width the bin values allow:
+    # 4-bit (8/word) under 16 bins, 6-bit (5/word) under 64, else the
+    # 8-bit meta layout (multiclass at max_bin 255) keeps 4/word
+    bmax = bins.max(initial=0)
+    if compact and bmax < 16:
+        bits = 4
+    elif compact and bmax < 64:
+        bits = 6
+    else:
+        bits = 8
+    bpw = _bpw_for_bits(bits)
     wcnt = (f + bpw - 1) // bpw
     lanes, w_pad = lane_layout(wcnt, with_bag, compact, num_class,
                                with_prob)

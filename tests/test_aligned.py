@@ -76,6 +76,23 @@ def test_aligned_matches_leafwise_255bin():
         np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5)
 
 
+def test_aligned_matches_leafwise_15bin():
+    """max_bin=15 exercises the 4-BIT packing (8 bins/word, the
+    reference's dense_nbits 2-bins/byte analogue)."""
+    X, y = _make()
+    a = _train(X, y, "aligned", extra={"max_bin": 15})
+    b = _train(X, y, "leafwise", extra={"max_bin": 15})
+    from lightgbm_tpu.models.aligned_builder import AlignedEngine  # noqa
+    eng = a._gbdt._aligned_eng_ref
+    assert eng is not None and eng.bits == 4 and eng.W == 8
+    ta, tb = _tree_tuples(a), _tree_tuples(b)
+    assert len(ta) == len(tb)
+    for (fa, tha, va), (fb, thb, vb) in zip(ta, tb):
+        assert fa == fb
+        assert tha == thb
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5)
+
+
 def test_aligned_matches_leafwise_regression():
     X, y = _make()
     y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + y
