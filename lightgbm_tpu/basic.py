@@ -584,6 +584,26 @@ class Booster:
                             break
             if ncols == nf_model:
                 cfg.label_column = "-1"
+                # ambiguity warning: a LABELED file with one fewer
+                # feature than the model hits this same branch and would
+                # silently shift every feature by one. Flag it when the
+                # first column looks label-like (small integers).
+                try:
+                    tok = first.replace("\t", ",").replace(" ", ",") \
+                        .split(",")[0]
+                    v = float(tok)
+                    if np.isfinite(v) and v == int(v) and 0 <= v <= 100:
+                        from .utils import log
+                        log.warning(
+                            f"treating {data!r} as label-free because its "
+                            f"column count ({ncols}) equals the model's "
+                            f"feature count, but the first column looks "
+                            f"label-like; if this file HAS labels, the "
+                            f"features are mis-aligned — score a file "
+                            f"with {nf_model + 1} columns or strip the "
+                            f"label column")
+                except ValueError:
+                    pass
             _, feats, _ex = DatasetLoader(cfg).parse_file(data)
             if ncols == -1 and nf_model > 0 and feats.shape[1] < nf_model:
                 # ragged LibSVM scoring rows: absent trailing features

@@ -17,6 +17,7 @@ works for datasets, sidecars, and model files alike.
 """
 from __future__ import annotations
 
+import inspect
 import os
 from typing import Callable, Dict
 
@@ -51,16 +52,45 @@ def _fsspec_open(path: str, mode: str, **kw):
     return fsspec.open(path, mode, **kw).open()
 
 
+def _accepts_kwargs(opener: Callable, kw: Dict):
+    """True/False when `opener`'s signature (does not) take every keyword
+    in `kw`; None when the signature is not introspectable."""
+    try:
+        sig = inspect.signature(opener)
+    except (TypeError, ValueError):
+        return None     # not introspectable: caller falls back on retry
+    params = sig.parameters.values()
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params):
+        return True
+    names = {p.name for p in params
+             if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                           inspect.Parameter.KEYWORD_ONLY)}
+    return all(k in names for k in kw)
+
+
 def open_file(path: str, mode: str = "r", **kw):
     """Open a local or registered-remote file (reference VirtualFile
     factory, file_io.cpp:21-58). Decode kwargs (errors=, encoding=)
     forward to every backend."""
     scheme = _scheme_of(path)
     if scheme in _SCHEMES:
-        try:
-            return _SCHEMES[scheme](path, mode, **kw)
-        except TypeError:
-            return _SCHEMES[scheme](path, mode)
+        opener = _SCHEMES[scheme]
+        # kwarg support is detected from the signature, NOT by retrying
+        # on TypeError: a TypeError raised inside the opener body must
+        # propagate, and silently dropping decode kwargs (errors=,
+        # encoding=) on a retry would mask real opener bugs. Openers
+        # whose signature is not introspectable (C extensions) keep the
+        # old retry behavior — there the ambiguity is unavoidable.
+        if kw:
+            ok = _accepts_kwargs(opener, kw)
+            if ok is False:
+                return opener(path, mode)
+            if ok is None:
+                try:
+                    return opener(path, mode, **kw)
+                except TypeError:
+                    return opener(path, mode)
+        return opener(path, mode, **kw)
     if scheme in _FSSPEC_SCHEMES:
         return _fsspec_open(path, mode, **kw)
     return open(path, mode, **kw)

@@ -396,6 +396,16 @@ class DatasetLoader:
             kept_gi.append(gi)
             pos += k
 
+        # pass 2 re-reads the file: a stream-backed virtual filesystem
+        # whose content changed between passes would mis-bin silently —
+        # push_rows catches growth but only finish_load's late error
+        # catches shrinkage, so check the kept-row totals match here
+        if pos != n_kept:
+            raise ValueError(
+                f"two_round pass 2 saw {pos} rows but pass 1 sampled "
+                f"{n_kept}: the data file changed between passes (is the "
+                f"path a non-rewindable stream?)")
+
         group_sizes = None
         if side_q is not None:
             group_sizes = side_q.astype(np.int64)

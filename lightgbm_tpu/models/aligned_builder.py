@@ -23,8 +23,13 @@ the row dimension, so nothing is ever unpermuted on the hot path. The
 score in row order is materialized lazily (metrics, model dump) via the
 rid lane.
 
-Restrictions (callers fall back to the level/leaf-wise builders):
-numerical features only, single-class elementwise objectives, no bagging.
+Restrictions (callers fall back to the level/leaf-wise builders — the
+authoritative gate is `DeviceTreeLearner.aligned_mode_ok`): serial
+parallelism, n <= 2^24 rows, <= 1020 features, NC <= 65535 chunks,
+max_bin <= 256, and an objective that is either pointwise (any
+missing-type/categorical feature mix, bagging and multiclass included)
+or non-pointwise at >= 4M rows (where the external-gradient round-trip
+amortizes).
 """
 from __future__ import annotations
 
@@ -180,7 +185,7 @@ class AlignedEngine:
         rec, self.wcnt, self.W, cnts, self.bits = pack_records(
             bins, label, weight, self.C, with_bag=bagged,
             compact=self.compact, num_class=num_class,
-            with_prob=with_prob)
+            with_prob=with_prob, max_bin=learner.max_bin_global)
         self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
                                     compact=self.compact,
                                     num_class=num_class,

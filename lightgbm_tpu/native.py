@@ -27,32 +27,45 @@ FMT_CSV, FMT_TSV, FMT_LIBSVM = 0, 1, 2
 _FMT_NAMES = {FMT_CSV: "csv", FMT_TSV: "tsv", FMT_LIBSVM: "libsvm"}
 
 
-def _build() -> Optional[str]:
+def _build():
+    """(path-or-None, reason): locate or build the .so; `reason` explains
+    a None path (sources absent vs an actual make/compiler failure)."""
     path = os.path.join(_SRC_DIR, _LIB_NAME)
     src = os.path.join(_SRC_DIR, "text_parser.cpp")
     if not os.path.isfile(src):
-        return path if os.path.isfile(path) else None
+        if os.path.isfile(path):
+            return path, ""
+        return None, "native sources not present and no prebuilt .so"
     try:
         # make is a no-op when the .so is newer than every source
         subprocess.run(["make", "-C", _SRC_DIR], check=True,
                        capture_output=True, timeout=120)
-    except Exception:
-        pass  # a prebuilt .so (if any) still works
-    return path if os.path.isfile(path) else None
+    except Exception as e:
+        # a prebuilt .so (if any) still works
+        if os.path.isfile(path):
+            return path, ""
+        return None, f"build failed ({e})"
+    return (path, "") if os.path.isfile(path) else \
+        (None, "build produced no library")
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None when unavailable."""
+    """Load (building if needed) the native library; None when unavailable.
+    Warns ONCE at default verbosity when the .so fails to build/load —
+    ingest and batch predict silently degrading to the Python path was
+    too easy to miss otherwise."""
     global _lib, _lib_tried
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    path = _build()
+    path, reason = _build()
     if path is None:
+        _warn_unavailable(reason)
         return None
     try:
         lib = ctypes.CDLL(path)
-    except OSError:
+    except OSError as e:
+        _warn_unavailable(f"load failed: {e}")
         return None
     lib.lgbt_scan.restype = ctypes.c_int32
     lib.lgbt_scan.argtypes = [
@@ -85,6 +98,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         pass
     _lib = lib
     return _lib
+
+
+def _warn_unavailable(reason: str) -> None:
+    from .utils import log
+    log.warning(
+        f"native helper library ({_LIB_NAME}) unavailable — {reason}; "
+        f"text parsing, bin finding, and batch prediction fall back to "
+        f"the (slower) pure-Python path")
 
 
 def native_available() -> bool:

@@ -140,17 +140,21 @@ def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
 def pack_records(bins: np.ndarray, label: np.ndarray,
                  weight, chunk: int, with_bag: bool = False,
                  compact: bool = False, num_class: int = 1,
-                 with_prob: bool = False):
+                 with_prob: bool = False, max_bin: int = 0):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
     rows in chunk i (C except the last).
     """
     n, f = bins.shape
-    # compact packing at the narrowest width the bin values allow:
-    # 4-bit (8/word) under 16 bins, 6-bit (5/word) under 64, else the
-    # 8-bit meta layout (multiclass at max_bin 255) keeps 4/word
-    bmax = bins.max(initial=0)
+    # compact packing at the narrowest width the MAPPERS' bin range
+    # allows (max_bin = max num_bin over used mappers; falls back to the
+    # observed data max when the caller has no mappers): 4-bit (8/word)
+    # under 16 bins, 6-bit (5/word) under 64, else the 8-bit meta layout
+    # (multiclass at max_bin 255) keeps 4/word. Deriving from num_bin
+    # rather than bins.max() means a split threshold in the (possibly
+    # data-empty) upper bin range is always representable in-width.
+    bmax = max(int(bins.max(initial=0)), max_bin - 1)
     if compact and bmax < 16:
         bits = 4
     elif compact and bmax < 64:

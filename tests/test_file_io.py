@@ -88,7 +88,12 @@ def test_remote_binary_dataset_roundtrip(mem_fs):
 
 
 def test_unregistered_remote_scheme_raises():
-    # no registered opener: either our FileNotFoundError (no fsspec) or
-    # fsspec's backend error for the unreachable cluster
-    with pytest.raises(Exception):
+    # no registered opener: our RuntimeError when fsspec is absent, or
+    # fsspec's backend error for the unreachable cluster when present
+    try:
+        import fsspec  # noqa: F401
+        expected = Exception          # backend-specific error
+    except ImportError:
+        expected = RuntimeError       # _fsspec_open's explicit error
+    with pytest.raises(expected):
         file_io.open_file("hdfs://cluster/x.txt")
