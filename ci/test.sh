@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# CI harness (the reference's .ci/test.sh analogue): native build, package
+# install smoke test, then the fast test tier on a virtual 8-device CPU
+# mesh. Usage: ci/test.sh [fast|full|install]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-fast}"
+
+echo "== native build =="
+make -C src/native
+python - <<'EOF'
+from lightgbm_tpu import native
+assert native.native_available(), "native .so failed to load"
+print("native helpers: ok")
+EOF
+
+if [ "$MODE" = "install" ] || [ "$MODE" = "full" ]; then
+    echo "== pip install smoke test (wheel build + target install) =="
+    TGT="$(mktemp -d)"
+    # --no-build-isolation: CI images are airgapped; setuptools is baked in
+    pip install -q . --target "$TGT" --no-deps --no-build-isolation
+    PKGTEST_TARGET="$TGT" python - <<'EOF'
+import os
+import sys
+sys.path.insert(0, os.environ["PKGTEST_TARGET"])
+import numpy as np
+import lightgbm_tpu as lgb
+assert os.environ["PKGTEST_TARGET"] in lgb.__file__, lgb.__file__
+rng = np.random.RandomState(0)
+X = rng.rand(400, 5)
+y = (X[:, 0] + 0.2 * rng.randn(400) > 0.5).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                lgb.Dataset(X, label=y), num_boost_round=10)
+p = bst.predict(X)
+assert p.shape == (400,) and np.all((p >= 0) & (p <= 1))
+s = bst.model_to_string()
+p2 = lgb.Booster(model_str=s).predict(X)
+np.testing.assert_allclose(p, p2, rtol=1e-6)
+from lightgbm_tpu import native
+assert native.native_available(), "installed package lost native helpers"
+print("install smoke test: ok")
+EOF
+    rm -rf "$TGT"
+fi
+
+echo "== tests ($MODE tier) =="
+if [ "$MODE" = "full" ]; then
+    python -m pytest tests/ -q
+else
+    python -m pytest tests/ -q -m "not slow"
+fi

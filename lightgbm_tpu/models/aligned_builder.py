@@ -28,8 +28,8 @@ authoritative gate is `DeviceTreeLearner.aligned_mode_ok`): serial
 parallelism, n <= 2^24 rows, <= 1020 features, NC <= 65535 chunks,
 max_bin <= 256, and an objective that is either pointwise (any
 missing-type/categorical feature mix, bagging and multiclass included)
-or non-pointwise at >= 4M rows (where the external-gradient round-trip
-amortizes).
+or non-pointwise at >= 1M rows (where the external-gradient round-trip
+amortizes; forced tpu_grow_mode=aligned bypasses the floor).
 """
 from __future__ import annotations
 
@@ -145,7 +145,7 @@ class AlignedEngine:
         # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
         # NC at 65k chunks
         from ..ops.aligned import effective_chunk
-        self.C = C = effective_chunk(self.cfg)
+        self.C = C = effective_chunk(self.cfg, learner.num_features)
         bins = np.asarray(learner.ds.bins)
         if learner.num_features != learner.num_real_features:
             pad = learner.num_features - learner.num_real_features
@@ -174,22 +174,33 @@ class AlignedEngine:
                 objective._label_np).astype(np.int64)
         else:
             self.mc_mode = None
+            # no bin-width condition: at max_bin <= 64 compact packs
+            # 6-bit bins; above it keeps 8-bit words but still drops the
+            # label/grad/hess/rid/weight lanes (g/h recompute in-kernel
+            # from score + meta), shrinking the route matmul and killing
+            # the per-iteration grad-lane pass at 255 bins
             self.compact = bool(
                 objective.point_grad_fn() is not None
                 and weight is None and lab01
-                and learner.n <= (1 << 24)  # rid must fit 24 meta bits
-                and learner.max_bin_global <= 64
-                and all(m.num_bin <= 64
-                        for m in learner.ds.used_mappers()))
+                and learner.n <= (1 << 24))  # rid must fit 24 meta bits
         with_prob = self.mc_mode == "prob"
+        # external-gradient objectives (ranking) drop the label/weight
+        # lanes: g/h arrive in row order with weights folded in
+        self.ext = (not self.compact and num_class == 1
+                    and objective.point_grad_fn() is None)
+        self.gh_off = 1 if self.ext else 2
         rec, self.wcnt, self.W, cnts, self.bits = pack_records(
             bins, label, weight, self.C, with_bag=bagged,
             compact=self.compact, num_class=num_class,
-            with_prob=with_prob, max_bin=learner.max_bin_global)
+            with_prob=with_prob, max_bin=learner.max_bin_global,
+            ext=self.ext)
         self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
                                     compact=self.compact,
                                     num_class=num_class,
-                                    with_prob=with_prob)
+                                    with_prob=with_prob, ext=self.ext)
+        # lanes actually carrying data (w_used <= W): only these ride
+        # the move pass's route matmul
+        self.w_used = max(self.lanes.values()) + 1
         self.n = learner.n
         L = self.cfg.num_leaves
         self.S = spec_slots(L, float(getattr(self.cfg, "tpu_level_spec",
@@ -300,9 +311,24 @@ class AlignedEngine:
         cfg = self.cfg
         C, NC, S = self.C, self.NC, self.S
         Sm1 = S - 1
-        # per-round split cap = compact hist-store height: must fit the
-        # move kernel's VMEM-resident store even at B=256 (~44 MB at 256)
-        K = min(Sm1, 256)
+        # per-round split cap = compact hist-store height: the move
+        # kernel's whole [K+1, ...] store is VMEM-resident, so K shrinks
+        # on wide-feature/high-bin shapes (e.g. F=137 at B=256 nibble
+        # blocks would need 216 MB at K=256) — fewer splits per round,
+        # more rounds, but the kernel still compiles
+        from ..ops.aligned import _hist_store_shape
+        slot_bytes = 4 * int(np.prod(
+            _hist_store_shape(0, lr.num_features, lr.max_bin_global,
+                              8 if lr.max_bin_global <= 64 else 4)[1:]))
+        import os as _os
+        kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0)
+        if not kcap:
+            # K=256 only while the whole [K+1] store stays under ~48 MB
+            # of VMEM (HIGGS-255 nibble store 44 MB measured fine);
+            # beyond that the kernel slows ~3x (F=137 cliff) -> K=64,
+            # the floor (K=32/48 faulted the TPU worker at wide F)
+            kcap = 256 if slot_bytes * 257 <= (48 << 20) else 64
+        K = min(Sm1, kcap)
         Lm1_commit = max(self.cfg.num_leaves - 1, 1)
         F = lr.num_features
         B = lr.max_bin_global
@@ -524,6 +550,7 @@ class AlignedEngine:
                                            F, B, C, group, wcnt,
                                            bag_lane=bag_lane, bits=bits,
                                            grad_fn=gfn, num_class=K_cls,
+                                           gh_off=self.gh_off,
                                            interpret=interpret)
             root_hist = _gsum(root_hist_all[0])
             root_g = jnp.sum(root_hist[0, :, 0])
@@ -710,6 +737,8 @@ class AlignedEngine:
                                       C, W, wcnt, K, F, B, group,
                                       bag_lane=bag_lane, bits=bits,
                                       grad_fn=gfn, num_class=K_cls,
+                                      w_used=self.w_used,
+                                      gh_off=self.gh_off,
                                       interpret=interpret)
 
                 # ---- updated tables (begins relaid for ALL slots)
@@ -768,29 +797,62 @@ class AlignedEngine:
                 leafI = leafI.at[:, LI_BEGIN].set(
                     jnp.where(exists2, new_begin, NC))
 
-                # ---- new per-chunk counts + child histograms
+                # ---- new per-chunk counts
                 slot_of2, cnt_of2, _, _, _ = chunk_maps(leafI, exists2)
                 cnts_pc = cnt_of2
-                sm_hist = _gsum(hout[jnp.clip(selrank, 0, K - 1)])
-                lg_hist = hist_store[s_ids] - sm_hist
-                left_hist = jnp.where(
-                    smaller_is_left[:, None, None, None], sm_hist, lg_hist)
-                right_hist = jnp.where(
-                    smaller_is_left[:, None, None, None], lg_hist, sm_hist)
-                sel4 = sel[:, None, None, None]
-                hist_store = jnp.where(sel4, left_hist, hist_store)
-                hist_store = hist_store.at[safe_right].set(
-                    jnp.where(sel4, right_hist, hist_store[safe_right]))
 
-                # ---- eval all slots
-                bF, bI, bB = eval_all(feature_mask_f32, hist_store,
-                                      leafF[:, LF_SG], leafF[:, LF_SH],
-                                      leafI[:, LI_COUNTG],
-                                      leafF[:, LF_MINC], leafF[:, LF_MAXC],
-                                      leafI[:, LI_DEPTH], exists2)
-                bestF = jnp.where(exists2[:, None], bF, bestF)
-                bestI = jnp.where(exists2[:, None], bI, bestI)
-                bestB = jnp.where(exists2[:, None], bB, bestB)
+                # ---- child histograms + eval on CHANGED slots only,
+                # [K]-compact by selection rank: the [S+1, F, B, 3] store
+                # is touched by one gather + two scatters instead of six
+                # full-store passes, and the split finder runs on the 2k
+                # changed children instead of every slot (unchanged slots'
+                # cached best split cannot change). At F=137/B=256 shapes
+                # the full-store traffic dominated the round.
+                rk = jnp.arange(K, dtype=jnp.int32)
+                valid_rk = rk < jnp.minimum(k, K)
+                # slot_l[r] = tree slot of selection rank r (pad -> S, the
+                # dump slot: right children cap at S-1 so S is never live)
+                idx_sc = jnp.where(sel, jnp.clip(selrank, 0, K - 1), K)
+                slot_l = jnp.full(K + 1, S, jnp.int32).at[idx_sc].set(
+                    jnp.where(sel, s_ids, S))[:K]
+                slot_r = jnp.where(valid_rk, done + rk + 1, S)
+                sm_k = _gsum(hout)                      # [K, F, B, 3]
+                parent_k = hist_store[slot_l]
+                lg_k = parent_k - sm_k
+                sil_k = smaller_is_left[slot_l][:, None, None, None]
+                left_k = jnp.where(sil_k, sm_k, lg_k)
+                right_k = jnp.where(sil_k, lg_k, sm_k)
+                v4 = valid_rk[:, None, None, None]
+                hist_store = hist_store.at[slot_l].set(
+                    jnp.where(v4, left_k, parent_k))
+                # pad ranks target S with the old store row (parent_k of a
+                # pad IS hist_store[S]) -> consistent duplicate writes
+                hist_store = hist_store.at[slot_r].set(
+                    jnp.where(v4, right_k, parent_k))
+
+                # children stats for the finder ([K] gathers, all tiny)
+                dep_k = depth_new[slot_l]
+                lF, lI, lB = eval_all(
+                    feature_mask_f32, left_k, bestF[slot_l, BF_LG],
+                    bestF[slot_l, BF_LH], bestI[slot_l, BI_LC],
+                    lmin[slot_l], lmax[slot_l], dep_k, valid_rk)
+                rF, rI, rB = eval_all(
+                    feature_mask_f32, right_k, bestF[slot_l, BF_RG],
+                    bestF[slot_l, BF_RH], bestI[slot_l, BI_RC],
+                    rmin[slot_l], rmax[slot_l], dep_k, valid_rk)
+                vK = valid_rk[:, None]
+                bestF = bestF.at[slot_l].set(
+                    jnp.where(vK, lF, bestF[slot_l]))
+                bestI = bestI.at[slot_l].set(
+                    jnp.where(vK, lI, bestI[slot_l]))
+                bestB = bestB.at[slot_l].set(
+                    jnp.where(vK, lB, bestB[slot_l]))
+                bestF = bestF.at[slot_r].set(
+                    jnp.where(vK, rF, bestF[slot_r]))
+                bestI = bestI.at[slot_r].set(
+                    jnp.where(vK, rI, bestI[slot_r]))
+                bestB = bestB.at[slot_r].set(
+                    jnp.where(vK, rB, bestB[slot_r]))
 
                 # Replay-skip shortcut, at the PROVABLY equivalent
                 # threshold: with e = done + k execs, the capped replay

@@ -868,7 +868,8 @@ class DeviceTreeLearner:
         from .level_builder import spec_slots
         S = spec_slots(self.cfg.num_leaves,
                        float(getattr(self.cfg, "tpu_level_spec", 1.5)))
-        nc = aligned_num_chunks(self.n, self.cfg, S)
+        nc = aligned_num_chunks(self.n, self.cfg, S,
+                                self.num_features)
         return (self.parallel_mode == "serial"
                 and not self.bundled
                 # packed-prefetch limits: 16-bit destination chunk ids
@@ -891,14 +892,15 @@ class DeviceTreeLearner:
                      or (objective.num_model_per_iteration <= 127
                          and objective.mc_lane_mode() is not None))
                 # non-pointwise objectives pay a row-order gradient
-                # round-trip (materialize + gather) and wide-feature
-                # records (no compact layout): measured round 4 at the
-                # MSLR shape (2.27M x 137, W=48) the aligned path is
-                # 2.1 s/iter vs the fused builder's 1.27 — the gate
-                # stays at 4M rows where the tree build dominates
+                # round-trip (materialize + gather); the ext record
+                # layout (round 5) plus the [K]-compact hist/eval path
+                # made this a win at the MSLR shape (2.27M x 137), so the
+                # gate is now just a floor where the round-trip
+                # amortizes; forced tpu_grow_mode=aligned bypasses it
                 and (objective.point_grad_fn() is not None
                      or objective.num_model_per_iteration > 1
-                     or self.n >= 4_000_000))
+                     or self.n >= 1_000_000
+                     or mode == "aligned"))
 
     def aligned_engine(self, objective, init_row_scores=None,
                        bagged=False, num_class=1):

@@ -17,8 +17,12 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "src", "native")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+# repo checkout layout first; installed-package layout (_native_src is
+# staged into the package by setup.py) as the fallback
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "src", "native")
+if not os.path.isdir(_SRC_DIR):
+    _SRC_DIR = os.path.join(_PKG_DIR, "_native_src")
 _LIB_NAME = "liblgbt_native.so"
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False

@@ -70,17 +70,26 @@ R_CAT = 25         # bit 25      categorical split (bitset routing)
 # meta word: cnt | first << 20 | last << 21
 
 
-def effective_chunk(cfg) -> int:
-    """The chunk size the aligned engine will actually run at (512
-    measured best on v5e at 10.5M rows; tpu_chunk overrides)."""
+def effective_chunk(cfg, num_features: int = 0) -> int:
+    """The chunk size the aligned engine will actually run at. 1024
+    measured best on v5e at the HIGGS shape (10.5M x 28) once the route
+    one-hot was factored to [C, C] — per-chunk fixed costs dominate the
+    split path, so halving the chunk count beats the narrower one-hot —
+    but WIDE records regress hard at 1024 (F=137: 2.0 s/iter vs 0.66 at
+    512; per-chunk VMEM temps scale with W*C), so records wider than
+    ~40 features stay at 512. 2048 regresses on VMEM pressure
+    everywhere. tpu_chunk overrides."""
     C = int(getattr(cfg, "tpu_chunk", 0) or 0)
-    return C if C > 0 else 512
+    if C > 0:
+        return C
+    return 1024 if num_features <= 40 else 512
 
 
-def aligned_num_chunks(n: int, cfg, spec_slots: int) -> int:
+def aligned_num_chunks(n: int, cfg, spec_slots: int,
+                       num_features: int = 0) -> int:
     """NC of the engine's record matrix: data chunks + one fresh chunk
     per speculative slot + 2 (must mirror AlignedEngine.__init__)."""
-    C = effective_chunk(cfg)
+    C = effective_chunk(cfg, num_features)
     return (n + C - 1) // C + spec_slots + 2
 
 
@@ -101,7 +110,8 @@ def _bpw_for_bits(bits: int) -> int:
 
 
 def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
-                num_class: int = 1, with_prob: bool = False):
+                num_class: int = 1, with_prob: bool = False,
+                ext: bool = False):
     """(lane indices, padded W) for a record with `wcnt` bin words.
 
     COMPACT layout (lane-wise objectives with small-integer labels,
@@ -110,9 +120,20 @@ def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
     recomputed in-kernel from (scores, label) instead of riding as
     lanes, halving the record (W 16 -> 8 at HIGGS shape) and with it
     every DMA and the route matmul of the move pass. `score` is the
-    FIRST of the num_class score lanes (class k at score + k)."""
+    FIRST of the num_class score lanes (class k at score + k).
+
+    EXT layout (external-gradient objectives — ranking): the label and
+    weight lanes are dropped (the objective computes g/h in row order
+    with weights folded in; nothing in the kernels reads them), so the
+    record is bins + score + grad + hess + rid (+bag)."""
     ls = wcnt
-    if compact:
+    if ext:
+        lanes = dict(score=ls, grad=ls + 1, hess=ls + 2, rid=ls + 3)
+        w = wcnt + 4
+        if with_bag:
+            lanes["bag"] = w
+            w += 1
+    elif compact:
         lanes = dict(score=ls)
         w = wcnt + num_class
         if with_prob:
@@ -140,7 +161,8 @@ def lane_layout(wcnt: int, with_bag: bool = False, compact: bool = False,
 def pack_records(bins: np.ndarray, label: np.ndarray,
                  weight, chunk: int, with_bag: bool = False,
                  compact: bool = False, num_class: int = 1,
-                 with_prob: bool = False, max_bin: int = 0):
+                 with_prob: bool = False, max_bin: int = 0,
+                 ext: bool = False):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
@@ -164,7 +186,7 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     bpw = _bpw_for_bits(bits)
     wcnt = (f + bpw - 1) // bpw
     lanes, w_pad = lane_layout(wcnt, with_bag, compact, num_class,
-                               with_prob)
+                               with_prob, ext=ext)
     nc = (n + chunk - 1) // chunk
     n_pad = nc * chunk
     padded = np.zeros((n_pad, wcnt * bpw), np.uint8)
@@ -175,7 +197,11 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
         packed |= words[:, :, i] << (bits * i)
     rec = np.zeros((n_pad, w_pad), np.int32)
     rec[:, :wcnt] = packed.astype(np.int64).astype(np.int32)
-    if compact:
+    if ext:
+        rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
+        if with_bag:
+            rec[:n, lanes["bag"]] = np.ones(n, np.float32).view(np.int32)
+    elif compact:
         if num_class > 1:
             lab = np.asarray(label).astype(np.int64) & META_LABEL_MASK
         else:
@@ -247,13 +273,14 @@ def _cat_word(cbits_ref, ks, binv):
 
 
 def _payload_gh(rows, nvalid, chunk, wcnt, grad_fn, bag_lane,
-                num_class=1):
+                num_class=1, gh_off=2):
     """(g, h, take) for a [W, C] row block: lane-resident gradients
     (standard layout, or multiclass compact where per-class g/h were
     written from pre-iteration scores) or recomputed in-kernel
     (single-class compact, grad_fn not None — the objective's pointwise
     gradient inlined into the Pallas kernel). bag_lane: >= 0 an f32 0/1
-    lane, -2 the meta-lane bag BIT, -1 none."""
+    lane, -2 the meta-lane bag BIT, -1 none. gh_off: grad lane offset
+    from wcnt (2 in the standard layout, 1 in the ext layout)."""
     posh = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
     take = posh < nvalid
     if grad_fn is not None and num_class > 1:
@@ -271,8 +298,9 @@ def _payload_gh(rows, nvalid, chunk, wcnt, grad_fn, bag_lane,
         if bag_lane == -2:     # compact bagging: bag bit masks stats
             take = take & (((meta >> META_BAG) & 1) != 0)
     else:
-        g = lax.bitcast_convert_type(rows[wcnt + 2, :], jnp.float32)
-        h = lax.bitcast_convert_type(rows[wcnt + 3, :], jnp.float32)
+        g = lax.bitcast_convert_type(rows[wcnt + gh_off, :], jnp.float32)
+        h = lax.bitcast_convert_type(rows[wcnt + gh_off + 1, :],
+                                     jnp.float32)
         if bag_lane >= 0:
             bagv = lax.bitcast_convert_type(rows[bag_lane, :],
                                             jnp.float32)
@@ -375,9 +403,9 @@ def _hi_lo6(pay):
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  hslot_ref, cbits_ref, fetch_ref, rec_ref, rec_hbm_ref,
                  out_ref, hist_ref, stag,
-                 fbuf, hacc, cur_ref, sems, *, chunk, w_pad, wcnt,
+                 fbuf, hacc, cur_ref, sems, *, chunk, w_pad, w_used, wcnt,
                  num_features, b_pad, group, dummy, bag_lane,
-                 bits, grad_fn, num_class):
+                 bits, grad_fn, num_class, gh_off):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
@@ -451,7 +479,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         at full density on exactly the smaller child's rows. Bagged
         stats cover IN-BAG rows only (gbdt.cpp:209-275)."""
         g, h, take = _payload_gh(rows, nvalid, C, wcnt, grad_fn,
-                                 bag_lane, num_class)
+                                 bag_lane, num_class, gh_off)
         gm = jnp.where(take, g, 0.0)
         hm = jnp.where(take, h, 0.0)
         cntp = take.astype(jnp.float32)
@@ -522,32 +550,57 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                         2 * C + (cur_r + rank_r) % (2 * C))
         dst = jnp.where(valid, dst, 4 * C + 5)
 
+        # only the USED lanes ride the route matmul (w_used <= w_pad:
+        # 8-sublane padding and, under the compact layout, the unused
+        # tail lanes carry no data — pad lanes of the output stay stale,
+        # which is fine because no kernel reads past w_used)
+        U = w_used
+        # int8 byte planes: the MXU takes s8 x s8 -> s32 at twice the
+        # bf16 rate and the f32 -> i32 output converts disappear; byte
+        # values wrap to signed but `& 255` after the single-term
+        # selection recovers them exactly
         planes = jnp.concatenate(
-            [((rec >> (8 * b)) & 255).astype(jnp.bfloat16)
-             for b in range(4)], axis=0)                  # [4W, C]
-        iota_4c = lax.broadcasted_iota(jnp.int32, (C, 4 * C), 1)
-        route = (dst[:, None] == iota_4c).astype(jnp.bfloat16)
-        moved = lax.dot_general(planes, route, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        mi = moved.astype(jnp.int32)
-        W = w_pad
-        mrows = (mi[:W] | (mi[W:2 * W] << 8) | (mi[2 * W:3 * W] << 16)
-                 | (mi[3 * W:] << 24))
+            [((rec[:U] >> (8 * b)) & 255).astype(jnp.int8)
+             for b in range(4)], axis=0)                  # [4U, C]
+        # FACTORED route: dst = sc*C + lo (sc = staging chunk 0..3).
+        # A flat [C, 4C] one-hot costs 4C int32 compares per row on the
+        # VPU (2048 at C=512 — measured the dominant term of the split
+        # path); factoring into a per-sc payload split (4 compares +
+        # 4*4U products per row) times ONE [C, C] one-hot (C compares)
+        # cuts the VPU work ~3x at identical MXU MACs, and the sc blocks
+        # of the output are exactly the 4 staging chunks. Exact: each
+        # output (sc, lo) receives a single term < 256.
+        sc_of = dst // C                                  # 4 = invalid
+        lo_of = dst % C
+        Z = jnp.concatenate(
+            [jnp.where((sc_of == sc)[None, :], planes, 0)
+             for sc in range(4)], axis=0)                 # [4*4U, C]
+        iota_c2 = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+        oh_lo = (lo_of[:, None] == iota_c2).astype(jnp.int8)
+        moved = lax.dot_general(Z, oh_lo, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
 
-        pos4 = lax.broadcasted_iota(jnp.int32, (1, 4 * C), 1)[0]
+        posc = lax.broadcasted_iota(jnp.int32, (1, C), 1)[0]
         lo_l = cur_l % (2 * C)
         hi_l = lo_l + k_l
-        in_l = (pos4 >= lo_l) & (pos4 < hi_l)
-        in_l = in_l | ((pos4 + 2 * C >= lo_l) & (pos4 + 2 * C < hi_l))
-        in_l = in_l & (pos4 < 2 * C)
         lo_r = cur_r % (2 * C)
         hi_r = lo_r + k_v - k_l
-        pr = pos4 - 2 * C
-        in_r = (pr >= lo_r) & (pr < hi_r)
-        in_r = in_r | ((pr + 2 * C >= lo_r) & (pr + 2 * C < hi_r))
-        in_r = in_r & (pr >= 0)
-        mask = (in_l | in_r)[None, :]
-        stag[...] = jnp.where(mask, mrows, stag[...])
+        for sc in range(4):
+            blk = moved[sc * 4 * U:(sc + 1) * 4 * U] & 255
+            mrows = (blk[:U] | (blk[U:2 * U] << 8)
+                     | (blk[2 * U:3 * U] << 16) | (blk[3 * U:] << 24))
+            if U < w_pad:
+                mrows = jnp.concatenate(
+                    [mrows, jnp.zeros((w_pad - U, C), jnp.int32)], axis=0)
+            if sc < 2:
+                pos = sc * C + posc
+                m = ((pos >= lo_l) & (pos < hi_l)) \
+                    | ((pos + 2 * C >= lo_l) & (pos + 2 * C < hi_l))
+            else:
+                pr = (sc - 2) * C + posc
+                m = ((pr >= lo_r) & (pr < hi_r)) \
+                    | ((pr + 2 * C >= lo_r) & (pr + 2 * C < hi_r))
+            stag[sc] = jnp.where(m[None, :], mrows, stag[sc])
 
         new_l = cur_l + k_l
         new_r = cur_r + k_v - k_l
@@ -570,8 +623,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                             @pl.when(cur_ref[4 + slot] != 0)
                             def _():
                                 wait_slot(slot)
-                            fbuf[slot] = stag[:, 2 * C * side + p * C:
-                                              2 * C * side + (p + 1) * C]
+                            fbuf[slot] = stag[side * 2 + p]
                             pltpu.make_async_copy(
                                 fbuf.at[slot], out_ref.at[base + fl],
                                 sems.at[slot]).start()
@@ -608,11 +660,12 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
-    "group", "bag_lane", "bits", "grad_fn", "num_class", "interpret"))
+    "group", "bag_lane", "bits", "grad_fn", "num_class", "w_used",
+    "gh_off", "interpret"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
               chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
               bag_lane=-1, bits=8, grad_fn=None, num_class=1,
-              interpret=False):
+              w_used=0, gh_off=2, interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -640,10 +693,12 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
     store_shape = _hist_store_shape(num_slots, num_features, b_pad, group)
     hacc_shape = store_shape[1:]
     kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
+                               w_used=w_used or w_pad,
                                wcnt=wcnt, num_features=num_features,
                                b_pad=b_pad, group=group, dummy=dummy,
                                bag_lane=bag_lane, bits=bits,
-                               grad_fn=grad_fn, num_class=num_class)
+                               grad_fn=grad_fn, num_class=num_class,
+                               gh_off=gh_off)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     # copy chunks SKIP the blocked fetch: the block index carries the
@@ -669,7 +724,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
                          tuple(0 for _ in store_shape)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((w_pad, 4 * chunk), jnp.int32),
+            pltpu.VMEM((4, w_pad, chunk), jnp.int32),
             pltpu.VMEM((4, w_pad, chunk), jnp.int32),   # flush bufs
             pltpu.VMEM(hacc_shape, jnp.float32),
             pltpu.SMEM((40,), jnp.int32),
@@ -773,7 +828,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
 # ---------------------------------------------------------------------------
 def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
                       num_features, b_pad, group, chunk, wcnt, dummy,
-                      bag_lane, bits, grad_fn, num_class):
+                      bag_lane, bits, grad_fn, num_class, gh_off):
     i = pl.program_id(0)
     bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
@@ -788,7 +843,7 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
         ks = slots_ref[i]
         g, h, valid = _payload_gh(rec, meta_ref[i] & ((1 << 20) - 1),
                                   chunk, wcnt, grad_fn, bag_lane,
-                                  num_class)
+                                  num_class, gh_off)
         gm = jnp.where(valid, g, 0.0)
         hm = jnp.where(valid, h, 0.0)
         cnt = valid.astype(jnp.float32)
@@ -807,10 +862,10 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
-    "bag_lane", "bits", "grad_fn", "num_class", "interpret"))
+    "bag_lane", "bits", "grad_fn", "num_class", "gh_off", "interpret"))
 def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
                    chunk, group, wcnt, bag_lane=-1, bits=8, grad_fn=None,
-                   num_class=1, interpret=False):
+                   num_class=1, gh_off=2, interpret=False):
     """hist[num_slots, F, b_pad, 3] over the record matrix.
 
     slots[i] maps chunk i to its accumulation slot (a COMPACT id —
@@ -827,7 +882,7 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
                                b_pad=b_pad, group=group, chunk=chunk,
                                wcnt=wcnt, dummy=dummy, bag_lane=bag_lane,
                                bits=bits, grad_fn=grad_fn,
-                               num_class=num_class)
+                               num_class=num_class, gh_off=gh_off)
     w_pad = records.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

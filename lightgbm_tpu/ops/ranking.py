@@ -53,9 +53,24 @@ def bucket_queries(query_boundaries: np.ndarray, min_size: int = 8
     """
     qb = np.asarray(query_boundaries, np.int64)
     counts = np.diff(qb)
+    # ~sqrt(2)-spaced ladder (pow2 + 1.5x midpoints): pairwise work is
+    # O(S^2), so padding 129..160-doc queries to 192 instead of 256
+    # nearly halves their pair tensors for one extra compiled program
+    ladder = []
+    s = max(8, min_size)
+    while s <= (1 << 20):
+        ladder.append(s)
+        mid = s + s // 2
+        ladder.append(mid)
+        s <<= 1
+    ladder = sorted(set(ladder))
     sizes = {}
     for q, c in enumerate(counts):
-        s = max(min_size, 1 << int(math.ceil(math.log2(max(int(c), 1)))))
+        c = max(int(c), 1)
+        need = max(c, min_size)
+        s = next((x for x in ladder if x >= need), None)
+        if s is None:       # beyond the ladder: plain pow2 rounding
+            s = 1 << int(math.ceil(math.log2(need)))
         sizes.setdefault(s, []).append(q)
     out = {}
     for s, qids in sizes.items():

@@ -1,0 +1,39 @@
+"""Build hook: stage the native C++ sources inside the package and
+pre-build the helper .so when a toolchain is available (reference
+python-package/setup.py compiles lib_lightgbm at install time; here the
+library is optional — lightgbm_tpu/native.py also builds it lazily and
+falls back to pure Python with a warning)."""
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+NATIVE_SRC = os.path.join(ROOT, "src", "native")
+PKG_NATIVE = os.path.join(ROOT, "lightgbm_tpu", "_native_src")
+
+
+def _stage_native() -> None:
+    if not os.path.isdir(NATIVE_SRC):
+        return
+    os.makedirs(PKG_NATIVE, exist_ok=True)
+    for name in os.listdir(NATIVE_SRC):
+        if name.endswith((".cpp", ".h")) or name == "Makefile":
+            shutil.copy2(os.path.join(NATIVE_SRC, name),
+                         os.path.join(PKG_NATIVE, name))
+    try:  # best-effort pre-build; import-time make is the fallback
+        subprocess.run(["make", "-C", PKG_NATIVE], check=False,
+                       capture_output=True, timeout=300)
+    except Exception:
+        pass
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        _stage_native()
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

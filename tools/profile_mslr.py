@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""MSLR-shape lambdarank per-iter timing, aligned vs fused builder.
+
+python tools/profile_mslr.py [n] [max_bin] [iters] [mode]
+env: LSPEC (tpu_level_spec), TPU_CHUNK
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+def _argint(i, d):
+    try:
+        return int(sys.argv[i])
+    except (IndexError, ValueError):
+        return d
+
+
+N = _argint(1, 2_270_000)
+MB = _argint(2, 63)
+ITERS = _argint(3, 20)
+MODE = sys.argv[4] if len(sys.argv) > 4 else "aligned"
+F = 137
+CACHE = f"/tmp/mslr_shape_{N}_{F}.npz"
+
+
+def gen_data():
+    if os.path.exists(CACHE):
+        z = np.load(CACHE)
+        return z["X"], z["y"], z["group"]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    # reuse bench's synth_mslr without running bench
+    src = open(spec.origin).read()
+    ns = {}
+    import textwrap
+    start = src.index("def synth_mslr")
+    end = src.index("def ", start + 10)
+    exec("import numpy as np\n" + src[start:end], ns)
+    X, y, group = ns["synth_mslr"](N, F)
+    np.savez(CACHE, X=X, y=y, group=group)
+    return X, y, group
+
+
+def main():
+    import lightgbm_tpu as lgb
+    X, y, group = gen_data()
+    print(f"# data ready n={N} f={F} mb={MB} mode={MODE}", flush=True)
+    params = {
+        "objective": "lambdarank", "num_leaves": 255, "max_bin": MB,
+        "learning_rate": 0.1, "min_data_in_leaf": 50, "verbosity": -1,
+        "metric": "none",
+    }
+    if MODE != "auto":
+        params["tpu_grow_mode"] = MODE
+    if os.environ.get("LSPEC"):
+        params["tpu_level_spec"] = float(os.environ["LSPEC"])
+    if os.environ.get("TPU_CHUNK"):
+        params["tpu_chunk"] = int(os.environ["TPU_CHUNK"])
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X, label=y, group=group, params=params).construct()
+    print(f"# bin {time.perf_counter()-t0:.1f}s", flush=True)
+    bst = lgb.Booster(params=params, train_set=ds)
+    gb = bst._gbdt
+    t0 = time.perf_counter()
+    bst.update()
+    import jax
+    print(f"# compile+first iter {time.perf_counter()-t0:.1f}s", flush=True)
+    for _ in range(2):
+        bst.update()
+    eng = getattr(gb, "_aligned_eng_ref", None)
+    if eng is not None:
+        jax.block_until_ready(eng.rec[0, 0, :1])
+        print(f"# aligned engine: W={eng.W} w_used={eng.w_used} "
+              f"ext={eng.ext} C={eng.C}", flush=True)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        bst.update()
+    if eng is not None:
+        jax.block_until_ready(eng.rec[0, 0, :1])
+    else:
+        sc = gb.score_updater.score if hasattr(gb, "score_updater") else None
+        import jax as j
+        j.block_until_ready(gb._train_score()) if hasattr(
+            gb, "_train_score") else None
+    dt = (time.perf_counter() - t0) / ITERS
+    fb = getattr(gb, "_aligned_fallback_count", 0)
+    print(f"per_iter={dt*1e3:.1f}ms fallbacks={fb}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,8 @@ def main():
     }
     if os.environ.get("LSPEC"):
         params["tpu_level_spec"] = float(os.environ["LSPEC"])
+    if os.environ.get("TPU_CHUNK"):
+        params["tpu_chunk"] = int(os.environ["TPU_CHUNK"])
     t0 = time.perf_counter()
     train_set = lgb.Dataset(bins.astype(np.float32), label=label,
                             params=params).construct()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Trace MSLR-shape aligned iterations; aggregate device op durations.
+python tools/trace_mslr.py [n] [max_bin] [mode]"""
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_270_000
+MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
+MODE = sys.argv[3] if len(sys.argv) > 3 else "aligned"
+NTRACE = 3
+LOG = "/tmp/jaxtrace_mslr"
+
+
+def main():
+    import jax
+    import time
+    import lightgbm_tpu as lgb
+    from profile_mslr import gen_data
+    X, y, group = gen_data()
+    params = {
+        "objective": "lambdarank", "num_leaves": 255, "max_bin": MB,
+        "learning_rate": 0.1, "min_data_in_leaf": 50, "verbosity": -1,
+        "metric": "none", "tpu_grow_mode": MODE,
+    }
+    if os.environ.get("LSPEC"):
+        params["tpu_level_spec"] = float(os.environ["LSPEC"])
+    if os.environ.get("TPU_CHUNK"):
+        params["tpu_chunk"] = int(os.environ["TPU_CHUNK"])
+    ds = lgb.Dataset(X, label=y, group=group, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    gb = bst._gbdt
+
+    def sync():
+        eng = getattr(gb, "_aligned_eng_ref", None)
+        if eng is not None:
+            jax.block_until_ready(eng.rec[0, 0, :1])
+
+    for i in range(6):
+        t0 = time.perf_counter()
+        bst.update()
+        sync()
+        print(f"warm iter {i}: {time.perf_counter()-t0:.3f}s", flush=True)
+    os.system(f"rm -rf {LOG}")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(LOG):
+        for _ in range(NTRACE):
+            bst.update()
+        sync()
+    wall = time.perf_counter() - t0
+    print(f"traced {NTRACE} iters wall={wall:.3f}s "
+          f"({wall/NTRACE*1000:.1f} ms/iter)", flush=True)
+
+    files = glob.glob(f"{LOG}/**/*.trace.json.gz", recursive=True)
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    for fn in files:
+        with gzip.open(fn, "rt") as f:
+            data = json.load(f)
+        evs = data.get("traceEvents", [])
+        pname = {}
+        for ev in evs:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pname[ev.get("pid")] = ev.get("args", {}).get("name", "")
+        dev_pids = {p for p, nm in pname.items()
+                    if "TPU" in nm or "device" in nm.lower()}
+        for ev in evs:
+            if ev.get("ph") != "X":
+                continue
+            if dev_pids and ev.get("pid") not in dev_pids:
+                continue
+            agg[ev.get("name", "")] += ev.get("dur", 0)
+            cnt[ev.get("name", "")] += 1
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:30]
+    tot = sum(agg.values())
+    print(f"device total {tot/1e3/NTRACE:.1f} ms/iter", flush=True)
+    for name, us in top:
+        print(f"{us/(1e3*NTRACE):9.2f} ms/iter  x{cnt[name]//NTRACE:<6} "
+              f"{name[:100]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
