@@ -189,39 +189,85 @@ class AlignedEngine:
         self.ext = (not self.compact and num_class == 1
                     and objective.point_grad_fn() is None)
         self.gh_off = 1 if self.ext else 2
-        rec, self.wcnt, self.W, cnts, self.bits = pack_records(
-            bins, label, weight, self.C, with_bag=bagged,
-            compact=self.compact, num_class=num_class,
-            with_prob=with_prob, max_bin=learner.max_bin_global,
-            ext=self.ext)
-        self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
-                                    compact=self.compact,
-                                    num_class=num_class,
-                                    with_prob=with_prob, ext=self.ext)
-        # lanes actually carrying data (w_used <= W): only these ride
-        # the move pass's route matmul
-        self.w_used = max(self.lanes.values()) + 1
+        # DATA-PARALLEL (reference DataParallelTreeLearner over a GPU
+        # learner, tree_learner.cpp:13-36 + data_parallel_tree_learner
+        # .cpp:149-164): rows are sharded in contiguous per-shard blocks
+        # over the mesh's chunk axis; every jitted program runs under
+        # shard_map with the histogram psums at the _gsum seams already
+        # in the build, and split decisions replicate bit-identically
+        self.axis = (learner.axis_name
+                     if learner.parallel_mode == "data" else None)
+        self.nd = learner.mesh_size if self.axis else 1
+        self.mesh = getattr(learner, "_mesh", None)
+        assert self.axis is None or self.mesh is not None, \
+            "data-parallel aligned engine needs learner._mesh"
         self.n = learner.n
         L = self.cfg.num_leaves
         self.S = spec_slots(L, float(getattr(self.cfg, "tpu_level_spec",
                                              1.5)))
-        nc0 = rec.shape[0]
-        self.NC = nc0 + self.S + 2
-        rec_full = np.zeros((self.NC, self.W, self.C), np.int32)
-        rec_full[:nc0] = rec
+        import math as _math
+        self.per_shard = int(_math.ceil(self.n / self.nd))
+        label_arr = np.asarray(label) if label is not None else None
+        weight_arr = np.asarray(weight) if weight is not None else None
+        isc = None
         if init_row_scores is not None:
             isc = np.asarray(init_row_scores, np.float32)
             if isc.ndim == 1:
                 isc = isc[None, :]
-            for k in range(num_class):
-                sc = np.zeros(nc0 * self.C, np.float32)
-                sc[:self.n] = isc[k]
-                rec_full[:nc0, self.lanes["score"] + k, :] = \
-                    sc.reshape(nc0, self.C).view(np.int32)
-        cnts_full = np.zeros(self.NC, np.int32)
-        cnts_full[:nc0] = cnts
-        self.rec = jnp.asarray(rec_full)
-        self.cnts = jnp.asarray(cnts_full)
+        shard_recs = []
+        shard_cnts = []
+        for sh in range(self.nd):
+            lo = min(self.n, sh * self.per_shard)   # empty trailing shard
+            hi = min(self.n, lo + self.per_shard)
+            rec, self.wcnt, self.W, cnts, self.bits = pack_records(
+                bins[lo:hi],
+                label_arr[lo:hi] if label_arr is not None else None,
+                weight_arr[lo:hi] if weight_arr is not None else None,
+                self.C, with_bag=bagged, compact=self.compact,
+                num_class=num_class, with_prob=with_prob,
+                max_bin=learner.max_bin_global, ext=self.ext,
+                rid_base=lo)
+            # every shard's chunk grid has IDENTICAL static shape:
+            # ceil(per_shard/C) data chunks + S + 2 fresh
+            nc_data = (self.per_shard + C - 1) // C
+            nc_local = nc_data + self.S + 2
+            rec_full = np.zeros((nc_local, self.W, self.C), np.int32)
+            rec_full[:rec.shape[0]] = rec
+            cnts_full = np.zeros(nc_local, np.int32)
+            cnts_full[:len(cnts)] = cnts
+            shard_recs.append(rec_full)
+            shard_cnts.append(cnts_full)
+        self.NC = shard_recs[0].shape[0]     # per-shard chunk count
+        self.lanes, _ = lane_layout(self.wcnt, with_bag=bagged,
+                                    compact=self.compact,
+                                    num_class=num_class,
+                                    with_prob=with_prob, ext=self.ext)
+        if isc is not None:
+            nc_data = (self.per_shard + C - 1) // C
+            for sh in range(self.nd):
+                lo = min(self.n, sh * self.per_shard)
+                hi = min(self.n, lo + self.per_shard)
+                for k in range(num_class):
+                    sc = np.zeros(nc_data * self.C, np.float32)
+                    sc[:hi - lo] = isc[k, lo:hi]
+                    shard_recs[sh][:nc_data, self.lanes["score"] + k, :] = \
+                        sc.reshape(nc_data, self.C).view(np.int32)
+        # lanes actually carrying data (w_used <= W): only these ride
+        # the move pass's route matmul
+        self.w_used = max(self.lanes.values()) + 1
+        if self.nd == 1:    # serial: no copy of the full record matrix
+            rec_all, cnts_all = shard_recs[0], shard_cnts[0]
+        else:
+            rec_all = np.concatenate(shard_recs, axis=0)
+            cnts_all = np.concatenate(shard_cnts)
+        if self.axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(self.mesh, P(self.axis))
+            self.rec = jax.device_put(rec_all, sh)
+            self.cnts = jax.device_put(cnts_all, sh)
+        else:
+            self.rec = jnp.asarray(rec_all)
+            self.cnts = jnp.asarray(cnts_all)
         self._pgrad = objective.point_grad_fn()
         self._programs = {}
         self._score_cache = None     # (iter_tag, np array)
@@ -241,7 +287,8 @@ class AlignedEngine:
         """Training scores in ROW order as a DEVICE array (for objectives
         whose gradients are not pointwise — ranking needs query-grouped
         rows, so gradients are computed in row order and re-ingested)."""
-        fn = self._program("mat", self._materialize_program)
+        fn = self._program("mat", self._materialize_program,
+                           specs=self._specs("mat") if self.axis else None)
         return fn(self.rec, self.cnts)
 
     # ------------------------------------------------------------------
@@ -682,13 +729,17 @@ class AlignedEngine:
                 meta_pc = (cnt_of
                            | (first.astype(jnp.int32) << 20)
                            | (last.astype(jnp.int32) << 21))
-                if bagged:
-                    # the histogram count channel is IN-BAG only under
-                    # bagging: the physical layout needs exact i32
-                    # whole-row counts from the dedicated count pass
-                    # (streams just the split-word sublane; the R_COPY
-                    # bit is never read there — counted chunks are
-                    # selected splits, whose copy bit is 0)
+                if bagged or dp:
+                    # the histogram count channel cannot drive the
+                    # physical layout when it is IN-BAG only (bagging,
+                    # gbdt.cpp:209-275) or GLOBAL (data-parallel: BI_LC
+                    # is the psum-reduced count; the shard's local
+                    # layout needs its own rows' left counts,
+                    # data_parallel_tree_learner.cpp:251-257): exact i32
+                    # per-shard counts come from the dedicated count
+                    # pass (streams just the split-word sublane; the
+                    # R_COPY bit is never read there — counted chunks
+                    # are selected splits, whose copy bit is 0)
                     ks_s = jnp.where(sel, jnp.clip(selrank, 0, K - 1), K)
                     ks_pc = jnp.where(in_any & sel[slot_of],
                                       ks_s[slot_of], K)
@@ -953,12 +1004,51 @@ class AlignedEngine:
         return build
 
     # ------------------------------------------------------------------
-    def _program(self, key, factory, donate=()):
+    def _program(self, key, factory, donate=(), specs=None):
+        """jit (and, data-parallel, shard_map) a program factory. specs =
+        (in_specs, out_specs) pytrees of PartitionSpec for the DP case;
+        programs whose inputs are all replicated pass specs=None and run
+        unwrapped (XLA replicates them across the mesh)."""
         fn = self._programs.get(key)
         if fn is None:
-            fn = jax.jit(factory(), donate_argnums=donate)
+            inner = factory()
+            if self.axis is not None and specs is not None:
+                inner = jax.shard_map(inner, mesh=self.mesh,
+                                      in_specs=specs[0],
+                                      out_specs=specs[1],
+                                      check_vma=False)
+            fn = jax.jit(inner, donate_argnums=donate)
             self._programs[key] = fn
         return fn
+
+    def _specs(self, kind):
+        """(in_specs, out_specs) for the DP shard_map wrap of each
+        program. The chunk axis of rec/cnts (and the per-shard physical
+        block tables leafI) shard over the mesh; split decisions and
+        exec/best tables replicate (identical global histograms on every
+        shard, data_parallel_tree_learner.cpp:167-248's FromMemory
+        restore made redundant by the psum)."""
+        from jax.sharding import PartitionSpec as P
+        ax = self.axis
+        spec_out = AlignedSpec(
+            rounds=P(), n_exec=P(), execF=P(), execI=P(), execB=P(),
+            bestF=P(), bestI=P(), bestB=P(), leafF=P(), leafI=P(ax),
+            first_c=P(), nxt_c=P(), cover=P())
+        if kind == "build":
+            return ((P(ax), P(ax), P(), P(), P()),
+                    (P(ax), P(ax), spec_out, P(), P(), P()))
+        if kind == "build_ext":
+            return ((P(ax), P(ax), P(), P(), P(), P(), P()),
+                    (P(ax), P(ax), spec_out, P(), P(), P()))
+        if kind == "mat":
+            return ((P(ax), P(ax)), P())
+        if kind == "setsc":
+            return ((P(ax), P()), P(ax))
+        if kind == "setbag":
+            return ((P(ax), P()), P(ax))
+        if kind == "undo":
+            return ((P(ax), P(ax), P(), P(), P(), P()), P(ax))
+        raise KeyError(kind)
 
     def train_iter(self, scale: float,
                    feature_mask: Optional[np.ndarray] = None,
@@ -975,12 +1065,15 @@ class AlignedEngine:
             fn = self._program(
                 "build_ext",
                 lambda: self._build_program(external_grads=True),
-                donate=(0,))
+                donate=(0,), specs=self._specs("build_ext")
+                if self.axis else None)
             rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact, grads[0], grads[1])
         else:
-            fn = self._program("build", self._build_program, donate=(0,))
+            fn = self._program("build", self._build_program, donate=(0,),
+                               specs=self._specs("build")
+                               if self.axis else None)
             rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact)
@@ -1063,7 +1156,9 @@ class AlignedEngine:
     def set_row_scores_lane(self, class_k: int, row_scores):
         fn = self._program(("setsc", class_k),
                            lambda: self._set_scores_program(class_k),
-                           donate=(0,))
+                           donate=(0,),
+                           specs=self._specs("setsc")
+                           if self.axis else None)
         self.rec = fn(self.rec, jnp.asarray(row_scores, jnp.float32))
         self._score_cache = None
 
@@ -1164,7 +1259,9 @@ class AlignedEngine:
         added, reconstructed from the spec's final leaf tables. Used
         when an eagerly-dispatched next iteration is abandoned (training
         stopped); restores the lane to metric-exactness."""
-        fn = self._program("undo", self._undo_program, donate=(0,))
+        fn = self._program("undo", self._undo_program, donate=(0,),
+                           specs=self._specs("undo")
+                           if self.axis else None)
         self.rec = fn(self.rec, spec.leafI, spec.cover, spec.n_exec,
                       applied, jnp.float32(scale))
         self._score_cache = None
@@ -1190,7 +1287,9 @@ class AlignedEngine:
     def set_bag(self, mask_rows):
         """Re-ingest a per-row 0/1 bagging mask into the bag lane (one
         streaming pass; called on bagging_freq boundaries)."""
-        fn = self._program("setbag", self._set_bag_program, donate=(0,))
+        fn = self._program("setbag", self._set_bag_program, donate=(0,),
+                           specs=self._specs("setbag")
+                           if self.axis else None)
         self.rec = fn(self.rec, jnp.asarray(mask_rows, jnp.float32))
 
     def _set_bag_program(self):
@@ -1241,7 +1340,8 @@ class AlignedEngine:
         metrics / dumps need this)."""
         if self._score_cache is not None:
             return self._score_cache
-        fn = self._program("mat", self._materialize_program)
+        fn = self._program("mat", self._materialize_program,
+                           specs=self._specs("mat") if self.axis else None)
         out = np.asarray(fn(self.rec, self.cnts))
         self._score_cache = out
         return out
@@ -1249,6 +1349,7 @@ class AlignedEngine:
     def _materialize_program(self):
         ln = self.lanes
         n, C, NC = self.n, self.C, self.NC
+        ax = self.axis
 
         def fn(rec, cnts):
             rid = self._rid_lanes(rec).reshape(-1)
@@ -1256,5 +1357,10 @@ class AlignedEngine:
             pos = jnp.arange(C, dtype=jnp.int32)
             valid = (pos[None, :] < cnts[:, None]).reshape(-1)
             rid = jnp.where(valid & (rid < n), rid, n)
-            return jnp.zeros(n + 1, jnp.float32).at[rid].set(sc)[:n]
+            out = jnp.zeros(n + 1, jnp.float32).at[rid].set(sc)[:n]
+            if ax is not None:
+                # each shard scatters only its own rows; the psum
+                # assembles the full row-order vector on every shard
+                out = lax.psum(out, ax)
+            return out
         return fn

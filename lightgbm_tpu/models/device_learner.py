@@ -870,7 +870,12 @@ class DeviceTreeLearner:
                        float(getattr(self.cfg, "tpu_level_spec", 1.5)))
         nc = aligned_num_chunks(self.n, self.cfg, S,
                                 self.num_features)
-        return (self.parallel_mode == "serial"
+        return (self.parallel_mode in ("serial", "data")
+                # multiclass deferred-application machinery (and its
+                # fallback) stays serial-only for now
+                and (self.parallel_mode == "serial"
+                     or (objective is not None
+                         and objective.num_model_per_iteration == 1))
                 and not self.bundled
                 # packed-prefetch limits: 16-bit destination chunk ids
                 # (NC <= 65535 at the EFFECTIVE chunk size) and 8-bit

@@ -413,7 +413,8 @@ class GBDT:
         mirror _mega_fused_eligible plus the learner's aligned_mode_ok
         (numerical features, pointwise single-class objective)."""
         return (self.use_fused
-                and type(self.learner) is DeviceTreeLearner
+                and (type(self.learner) is DeviceTreeLearner
+                     or getattr(self.learner, "mode", "") == "data")
                 and not getattr(self, "_aligned_disabled", False)
                 and self.num_tree_per_iteration == 1
                 and self._class_need_train[0]

@@ -162,11 +162,12 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
                  weight, chunk: int, with_bag: bool = False,
                  compact: bool = False, num_class: int = 1,
                  with_prob: bool = False, max_bin: int = 0,
-                 ext: bool = False):
+                 ext: bool = False, rid_base: int = 0):
     """Host-side ingest: [N, F] uint8 bins -> [NC, W, C] int32 records.
 
     Returns (records, wcnt, W, cnts) where cnts[i] is the number of valid
-    rows in chunk i (C except the last).
+    rows in chunk i (C except the last). rid_base offsets the stored row
+    ids (data-parallel shards pack their local rows with GLOBAL ids).
     """
     n, f = bins.shape
     # compact packing at the narrowest width the MAPPERS' bin range
@@ -198,7 +199,7 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     rec = np.zeros((n_pad, w_pad), np.int32)
     rec[:, :wcnt] = packed.astype(np.int64).astype(np.int32)
     if ext:
-        rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
+        rec[:, lanes["rid"]] = rid_base + np.arange(n_pad, dtype=np.int32)
         if with_bag:
             rec[:n, lanes["bag"]] = np.ones(n, np.float32).view(np.int32)
     elif compact:
@@ -206,7 +207,8 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
             lab = np.asarray(label).astype(np.int64) & META_LABEL_MASK
         else:
             lab = (np.asarray(label) > 0).astype(np.int64)
-        meta = np.arange(n_pad, dtype=np.int64)
+        meta = (rid_base + np.arange(n_pad, dtype=np.int64)) \
+            & META_RID_MASK
         meta[:n] |= lab << META_LABEL
         meta[:n] |= 1 << META_BAG     # all rows in-bag initially
         rec[:, lanes["meta"]] = meta.astype(np.int64).astype(np.uint32) \
@@ -214,7 +216,7 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     else:
         rec[:n, lanes["label"]] = np.asarray(label, np.float32) \
             .view(np.int32)
-        rec[:, lanes["rid"]] = np.arange(n_pad, dtype=np.int32)
+        rec[:, lanes["rid"]] = rid_base + np.arange(n_pad, dtype=np.int32)
         wv = np.ones(n, np.float32) if weight is None \
             else np.asarray(weight, np.float32)
         rec[:n, lanes["weight"]] = wv.view(np.int32)
@@ -223,7 +225,8 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     rec3 = np.ascontiguousarray(
         rec.reshape(nc, chunk, w_pad).transpose(0, 2, 1))
     cnts = np.full(nc, chunk, np.int32)
-    cnts[-1] = n - (nc - 1) * chunk
+    if nc:      # zero-row shards (uneven DP split) pack an empty grid
+        cnts[-1] = n - (nc - 1) * chunk
     return rec3, wcnt, w_pad, cnts, bits
 
 

@@ -59,6 +59,8 @@ class DataParallelTreeLearner:
         self.inner = DeviceTreeLearner(cfg, dataset, axis_name=self.axis_name,
                                        parallel_mode=self.mode,
                                        mesh_size=self.nd)
+        # the aligned engine shard_maps its programs over this mesh
+        self.inner._mesh = self.mesh
         self.cfg = cfg
         self.ds = dataset
         n = dataset.num_data

@@ -31,20 +31,8 @@ def gen_data():
     if os.path.exists(CACHE):
         z = np.load(CACHE)
         return z["X"], z["y"], z["group"]
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"))
-    # reuse bench's synth_mslr without running bench
-    src = open(spec.origin).read()
-    ns = {}
-    import textwrap
-    start = src.index("def synth_mslr")
-    end = src.index("def ", start + 10)
-    exec("import numpy as np\n" + src[start:end], ns)
-    X, y, group = ns["synth_mslr"](N, F)
+    import bench    # repo root is on sys.path; bench has a __main__ guard
+    X, y, group = bench.synth_mslr(N, F)
     np.savez(CACHE, X=X, y=y, group=group)
     return X, y, group
 
