@@ -85,6 +85,7 @@ _ALIASES: Dict[str, str] = {
     "init_score_file": "initscore_filename", "init_score": "initscore_filename",
     "input_init_score": "initscore_filename",
     "valid_data_init_scores": "valid_initscore_filenames",
+    "valid_data_initscores": "valid_initscore_filenames",
     "valid_init_score_file": "valid_initscore_filenames",
     "valid_init_score": "valid_initscore_filenames",
     "is_pre_partition": "pre_partition",

@@ -144,12 +144,20 @@ class AlignedEngine:
         # permutation matmul but doubles grid/DMA/glue fixed costs
         # (1148 vs 999 ms/iter); destinations pack 16-bit, capping
         # NC at 65k chunks
-        from ..ops.aligned import effective_chunk
-        self.C = C = effective_chunk(self.cfg, learner.num_features)
+        from ..ops.aligned import chunk_for
+        self.C = C = chunk_for(self.cfg, learner.num_features, learner.n)
         bins = np.asarray(learner.ds.bins)
-        if learner.num_features != learner.num_real_features:
+        # feature-parallel zero-padding only; under EFB bundling
+        # ds.bins holds the [N, G] bundled storage whose column count
+        # LEGITIMATELY differs from the feature count (bundling is
+        # serial-gated, so the two conditions never overlap)
+        if (not learner.bundled
+                and learner.num_features != learner.num_real_features):
             pad = learner.num_features - learner.num_real_features
             bins = np.pad(bins, ((0, 0), (0, pad)))
+        self.ncols = bins.shape[1]
+        pack_max_bin = (learner.hist_bins if learner.bundled
+                        else learner.max_bin_global)
         label = objective._label_np if objective._label_np is not None \
             else np.zeros(learner.n, np.float32)
         weight = objective._weight_np
@@ -225,7 +233,7 @@ class AlignedEngine:
                 weight_arr[lo:hi] if weight_arr is not None else None,
                 self.C, with_bag=bagged, compact=self.compact,
                 num_class=num_class, with_prob=with_prob,
-                max_bin=learner.max_bin_global, ext=self.ext,
+                max_bin=pack_max_bin, ext=self.ext,
                 rid_base=lo)
             # every shard's chunk grid has IDENTICAL static shape:
             # ceil(per_shard/C) data chunks + S + 2 fresh
@@ -364,9 +372,10 @@ class AlignedEngine:
         # blocks would need 216 MB at K=256) — fewer splits per round,
         # more rounds, but the kernel still compiles
         from ..ops.aligned import _hist_store_shape
+        _bh = lr.hist_bins if lr.bundled else lr.max_bin_global
         slot_bytes = 4 * int(np.prod(
-            _hist_store_shape(0, lr.num_features, lr.max_bin_global,
-                              8 if lr.max_bin_global <= 64 else 4)[1:]))
+            _hist_store_shape(0, self.ncols, _bh,
+                              8 if _bh <= 64 else 4)[1:]))
         import os as _os
         kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0)
         if not kcap:
@@ -379,6 +388,36 @@ class AlignedEngine:
         Lm1_commit = max(self.cfg.num_leaves - 1, 1)
         F = lr.num_features
         B = lr.max_bin_global
+        # EFB bundles (io/bundling.py): the records pack the ds.bins
+        # STORAGE columns — G bundle columns of <= 256 bins each (the
+        # reference GPU path's own constraint, dataset.cpp:78) — so the
+        # kernels histogram G x BH and routing unpacks bundle -> feature
+        # bin in-kernel; per-feature histograms expand at EVAL time only
+        # (expansion and parent-minus-sibling subtraction commute: both
+        # are linear, and the FixHistogram term uses the leaf's own
+        # totals, dataset.cpp:928-947)
+        bundled = lr.bundled
+        G = self.ncols
+        BH = lr.hist_bins if bundled else B
+        if bundled:
+            col_dev = lr._col_dev
+            boff_dev = lr._boff_dev
+            bpk_dev = lr._bpk_dev
+            emap = lr._emap_dev          # [F, B] flat indices into G*BH
+            edef = lr._edef_dev          # [F, B] default-bin mask (f32)
+
+            def expand_hist(h, sg, sh, cnt):
+                """[Ks, G, BH, 3] bundle hists -> [Ks, F, B, 3]; sg/sh/
+                cnt are the leaves' totals [Ks]."""
+                flat = h.reshape(h.shape[0], G * BH, NUM_HIST_STATS)
+                safe = jnp.clip(emap, 0, G * BH - 1)
+                out = flat[:, safe] * (emap >= 0)[None, :, :, None]
+                totals = jnp.stack([sg, sh, cnt.astype(jnp.float32)],
+                                   axis=-1)                   # [Ks, 3]
+                fix = totals[:, None, :] - jnp.sum(out, axis=2)
+                # counts must stay exact integers for min_data guards
+                fix = fix.at[..., 2].set(jnp.round(fix[..., 2]))
+                return out + edef[None, :, :, None] * fix[:, :, None, :]
         wcnt, W = self.wcnt, self.W
         ln = self.lanes
         finder = lr.finder
@@ -391,7 +430,7 @@ class AlignedEngine:
         nb_dev = jnp.asarray(nb_np)
         db_dev = jnp.asarray(db_np)
         mt_dev = jnp.asarray(mt_np)
-        group = 8 if B <= 64 else 4
+        group = 8 if BH <= 64 else 4
         interpret = self.interpret
         bagged = self.bagged
         # bag: f32 lane (standard) or meta bit (-2, compact); -1 = none
@@ -410,6 +449,13 @@ class AlignedEngine:
         prev_lane_off = ln["score"] + ((class_k - 1) % K_cls)
         axis = lr.axis_name
         dp = axis is not None and lr.parallel_mode == "data"
+        # above 2^24 rows the f32 histogram count sums lose row-level
+        # exactness for the biggest leaves, so the PHYSICAL layout takes
+        # its counts from the exact i32 count pass (split-decision
+        # counts stay histogram-driven: only leaves larger than 2^24
+        # rows see sub-ppm count fuzz there, far from any min_data
+        # guard; documented divergence)
+        big_n = self.n > (1 << 24)
 
         def _gsum(x):
             return lax.psum(x, axis) if dp else x
@@ -594,7 +640,7 @@ class AlignedEngine:
             # ---------- root ----------
             root_slots = jnp.zeros(NC, jnp.int32)
             root_hist_all = slot_hist_pass(rec, root_slots, cnts_pc, 1,
-                                           F, B, C, group, wcnt,
+                                           G, BH, C, group, wcnt,
                                            bag_lane=bag_lane, bits=bits,
                                            grad_fn=gfn, num_class=K_cls,
                                            gh_off=self.gh_off,
@@ -616,23 +662,28 @@ class AlignedEngine:
             leafI = leafI.at[0, LI_COUNT].set(local_cnt)
             leafI = leafI.at[0, LI_COUNTG].set(root_cnt_g)
 
-            hist_store = jnp.zeros((S + 1, F, B, NUM_HIST_STATS),
+            hist_store = jnp.zeros((S + 1, G, BH, NUM_HIST_STATS),
                                    jnp.float32)
             hist_store = hist_store.at[0].set(root_hist)
             execF = jnp.zeros((Sm1 + 1, SF_W), jnp.float32)
             execI = jnp.zeros((Sm1 + 1, SI_W), jnp.int32)
             execB = jnp.zeros((Sm1 + 1, 8), jnp.uint32)
 
-            exists0 = jnp.zeros((S + 1,), bool).at[0].set(True)
-            bF, bI, bB = eval_all(feature_mask_f32, hist_store,
-                                  leafF[:, LF_SG], leafF[:, LF_SH],
-                                  leafI[:, LI_COUNTG], leafF[:, LF_MINC],
-                                  leafF[:, LF_MAXC], leafI[:, LI_DEPTH],
-                                  exists0)
-            bestF = jnp.where(exists0[:, None], bF,
-                              jnp.full((S + 1, BF_W), NEG_INF, jnp.float32))
-            bestI = bI
-            bestB = bB
+            # root eval: slot 0 only (the old all-slots eval was pure
+            # waste, and bundle expansion makes it expensive too)
+            root_eh = root_hist[None]
+            if bundled:
+                root_eh = expand_hist(root_eh, root_g[None], root_h[None],
+                                      root_cnt_g[None])
+            rF0, rI0, rB0 = eval_all(
+                feature_mask_f32, root_eh, leafF[0:1, LF_SG],
+                leafF[0:1, LF_SH], leafI[0:1, LI_COUNTG],
+                leafF[0:1, LF_MINC], leafF[0:1, LF_MAXC],
+                leafI[0:1, LI_DEPTH], jnp.ones(1, bool))
+            bestF = jnp.full((S + 1, BF_W), NEG_INF,
+                             jnp.float32).at[0].set(rF0[0])
+            bestI = jnp.zeros((S + 1, BI_W), jnp.int32).at[0].set(rI0[0])
+            bestB = jnp.zeros((S + 1, 8), jnp.uint32).at[0].set(rB0[0])
 
             need0 = jnp.zeros(S + 1, bool).at[0].set(
                 bestF[0, BF_GAIN] > 0.0)
@@ -704,8 +755,9 @@ class AlignedEngine:
                 # counting pass over the rows needed. (A data-parallel
                 # port needs a per-shard count pass here.)
                 feat = bestI[:, BI_FEAT]
-                wsel_s = feat // bpw
-                shift_s = (feat % bpw) * bits
+                scol = col_dev[feat] if bundled else feat
+                wsel_s = scol // bpw
+                shift_s = (scol % bpw) * bits
                 # route words + chunk meta (shared by the count pass and
                 # the move pass; both read the OLD layout)
                 r1_s = (jnp.clip(bestI[:, BI_THR], 0, 255)
@@ -721,15 +773,18 @@ class AlignedEngine:
                     jnp.where(sel[:, None],
                               lax.bitcast_convert_type(bestB, jnp.int32),
                               0)).reshape(-1)
-                r2_s = (jnp.clip(db_dev[feat], 0, 0xFFFF)
-                        | (jnp.clip(nb_dev[feat], 0, 0xFFFF) << 16))
+                r2_s = (jnp.clip(db_dev[feat], 0, 511)
+                        | (jnp.clip(nb_dev[feat], 0, 511) << 9))
+                if bundled:
+                    r2_s = r2_s | (boff_dev[feat] << 18) \
+                        | (bpk_dev[feat] << 27)
                 r1_pc = r1_s[slot_of]
                 r2_pc = r2_s[slot_of]
                 wsel_pc = wsel_s[slot_of]
                 meta_pc = (cnt_of
                            | (first.astype(jnp.int32) << 20)
                            | (last.astype(jnp.int32) << 21))
-                if bagged or dp:
+                if bagged or dp or big_n:
                     # the histogram count channel cannot drive the
                     # physical layout when it is IN-BAG only (bagging,
                     # gbdt.cpp:209-275) or GLOBAL (data-parallel: BI_LC
@@ -745,7 +800,8 @@ class AlignedEngine:
                                       ks_s[slot_of], K)
                     phys = count_pass(rec, r1_pc, r2_pc, meta_pc,
                                       wsel_pc, ks_pc, cbits, K, C,
-                                      bits=bits, interpret=interpret)
+                                      bits=bits, bundled=bundled,
+                                      interpret=interpret)
                     left_local = jnp.where(
                         sel, phys[jnp.clip(selrank, 0, K - 1)],
                         leafI[:, LI_COUNT])
@@ -785,11 +841,12 @@ class AlignedEngine:
                 hslots_pc = jnp.where(in_any, hslot_s[slot_of], K)
                 rec, hout = move_pass(rec, r1_pc, r2_pc, bl_pc, br_pc,
                                       meta_pc, wsel_pc, hslots_pc, cbits,
-                                      C, W, wcnt, K, F, B, group,
+                                      C, W, wcnt, K, G, BH, group,
                                       bag_lane=bag_lane, bits=bits,
                                       grad_fn=gfn, num_class=K_cls,
                                       w_used=self.w_used,
                                       gh_off=self.gh_off,
+                                      bundled=bundled,
                                       interpret=interpret)
 
                 # ---- updated tables (begins relaid for ALL slots)
@@ -883,12 +940,20 @@ class AlignedEngine:
 
                 # children stats for the finder ([K] gathers, all tiny)
                 dep_k = depth_new[slot_l]
+                left_e, right_e = left_k, right_k
+                if bundled:
+                    left_e = expand_hist(
+                        left_k, bestF[slot_l, BF_LG],
+                        bestF[slot_l, BF_LH], bestI[slot_l, BI_LC])
+                    right_e = expand_hist(
+                        right_k, bestF[slot_l, BF_RG],
+                        bestF[slot_l, BF_RH], bestI[slot_l, BI_RC])
                 lF, lI, lB = eval_all(
-                    feature_mask_f32, left_k, bestF[slot_l, BF_LG],
+                    feature_mask_f32, left_e, bestF[slot_l, BF_LG],
                     bestF[slot_l, BF_LH], bestI[slot_l, BI_LC],
                     lmin[slot_l], lmax[slot_l], dep_k, valid_rk)
                 rF, rI, rB = eval_all(
-                    feature_mask_f32, right_k, bestF[slot_l, BF_RG],
+                    feature_mask_f32, right_e, bestF[slot_l, BF_RG],
                     bestF[slot_l, BF_RH], bestI[slot_l, BI_RC],
                     rmin[slot_l], rmax[slot_l], dep_k, valid_rk)
                 vK = valid_rk[:, None]
@@ -1211,6 +1276,11 @@ class AlignedEngine:
         nb = jnp.asarray(lr.meta["num_bin"], jnp.int32)
         db = jnp.asarray(lr.meta["default_bin"], jnp.int32)
         mt = jnp.asarray(lr.meta["missing_type"], jnp.int32)
+        bundled = lr.bundled
+        if bundled:
+            col = lr._col_dev
+            boff = lr._boff_dev
+            bpk = lr._bpk_dev
 
         def fn(score, vb, execI, execB, first_c, nxt_c, cover, scale,
                applied):
@@ -1226,9 +1296,14 @@ class AlignedEngine:
                 act = node < E_INF
                 e = jnp.clip(node, 0, Sm1)
                 f = execI[e, SI_FEAT]
+                scol = col[f] if bundled else f
                 binv = jnp.take_along_axis(
-                    vb, jnp.clip(f, 0, vb.shape[1] - 1)[:, None],
+                    vb, jnp.clip(scol, 0, vb.shape[1] - 1)[:, None],
                     axis=1)[:, 0].astype(jnp.int32)
+                if bundled:
+                    from ..ops.partition import bundle_unpack
+                    binv = bundle_unpack(binv, boff[f], bpk[f], db[f],
+                                         nb[f])
                 thr = execI[e, SI_THR]
                 dl = execI[e, SI_DEFLEFT] != 0
                 iscat = execI[e, SI_ISCAT] != 0

@@ -876,26 +876,30 @@ class DeviceTreeLearner:
                 and (self.parallel_mode == "serial"
                      or (objective is not None
                          and objective.num_model_per_iteration == 1))
-                and not self.bundled
+                # EFB bundles ride natively (round 5): records pack the
+                # <= 256-bin bundle columns, routing unpacks in-kernel,
+                # per-feature histograms expand at eval only
                 # packed-prefetch limits: 16-bit destination chunk ids
-                # (NC <= 65535 at the EFFECTIVE chunk size) and 8-bit
-                # word selectors (features <= 1020); n capped at 2^24
-                # because the layout trusts BI_LC — an f32 sum of
-                # histogram count stats, exact only below 2^24
+                # (NC <= 65535 at the EFFECTIVE chunk size, ~67M rows at
+                # C=1024) and 8-bit word selectors (features <= 1020).
+                # Above 2^24 rows the physical layout switches to the
+                # exact i32 count pass (see aligned_builder big_n)
                 and nc <= 65535
-                and self.n <= (1 << 24)
                 and self.num_features <= 1020
                 and self.ds.bins is not None
                 and self.ds.bins.dtype == np.uint8
                 and self.num_features > 0
                 and self.cfg.num_leaves >= 2
                 and self.max_bin_global <= 256
+                and self.hist_bins <= 256
                 and objective is not None
                 and (objective.num_model_per_iteration == 1
                      # multiclass rides K score lanes + lane-wise
-                     # in-program gradients (compact layout only)
+                     # in-program gradients (compact layout only: the
+                     # meta-lane rid keeps the 2^24-row cap there)
                      or (objective.num_model_per_iteration <= 127
-                         and objective.mc_lane_mode() is not None))
+                         and objective.mc_lane_mode() is not None
+                         and self.n <= (1 << 24)))
                 # non-pointwise objectives pay a row-order gradient
                 # round-trip (materialize + gather); the ext record
                 # layout (round 5) plus the [K]-compact hist/eval path

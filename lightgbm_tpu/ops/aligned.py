@@ -66,7 +66,10 @@ R_MT = 14          # bits 14..15 missing type
 R_COPY = 16        # bit 16      copy-through (unsplit block)
 R_WSEL = 17        # bits 17..24 split word lane of the block
 R_CAT = 25         # bit 25      categorical split (bitset routing)
-# route word 2: default_bin | num_bin << 16
+# route word 2: default_bin | num_bin << 9 | boff << 18 | bpk << 27
+# (9-bit bin fields: num_bin <= 256; boff/bpk are the EFB bundle unpack
+# params — one packed word keeps the scalar-prefetch SMEM budget at
+# 6 x NC words, bounding NC ~40K chunks = ~40M rows at C=1024)
 # meta word: cnt | first << 20 | last << 21
 
 
@@ -85,11 +88,30 @@ def effective_chunk(cfg, num_features: int = 0) -> int:
     return 1024 if num_features <= 40 else 512
 
 
+def chunk_for(cfg, num_features: int, n: int) -> int:
+    """effective_chunk, scaled up so the move pass's 6 per-chunk route
+    words fit the 1 MB scalar-prefetch SMEM budget (NC <= ~40K): very
+    large n doubles the chunk until NC fits — slower per row (wider
+    one-hots) but the only way a 50M+-row dataset trains aligned on one
+    chip at all. An explicit tpu_chunk is escalated the same way (the
+    pinned size would fail SMEM allocation outright), with a warning so
+    a user who benchmarked at the pinned size knows why timing moved."""
+    C0 = C = effective_chunk(cfg, num_features)
+    while n // C > 40_000:
+        C *= 2
+    if C != C0 and int(getattr(cfg, "tpu_chunk", 0) or 0):
+        from ..utils import log
+        log.warning(
+            f"tpu_chunk={C0} cannot hold {n} rows within the kernel's "
+            f"scalar-prefetch budget; using tpu_chunk={C} instead")
+    return C
+
+
 def aligned_num_chunks(n: int, cfg, spec_slots: int,
                        num_features: int = 0) -> int:
     """NC of the engine's record matrix: data chunks + one fresh chunk
     per speculative slot + 2 (must mirror AlignedEngine.__init__)."""
-    C = effective_chunk(cfg, num_features)
+    C = chunk_for(cfg, num_features, n)
     return (n + C - 1) // C + spec_slots + 2
 
 
@@ -170,17 +192,19 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
     ids (data-parallel shards pack their local rows with GLOBAL ids).
     """
     n, f = bins.shape
-    # compact packing at the narrowest width the MAPPERS' bin range
+    # bin words pack at the narrowest width the MAPPERS' bin range
     # allows (max_bin = max num_bin over used mappers; falls back to the
-    # observed data max when the caller has no mappers): 4-bit (8/word)
-    # under 16 bins, 6-bit (5/word) under 64, else the 8-bit meta layout
-    # (multiclass at max_bin 255) keeps 4/word. Deriving from num_bin
-    # rather than bins.max() means a split threshold in the (possibly
-    # data-empty) upper bin range is always representable in-width.
+    # observed data max when the caller has no mappers): 4-bit (8/word,
+    # the reference's dense_nbits_bin.hpp:42 two-bins-per-byte at twice
+    # the density) under 16 bins, 6-bit (5/word) under 64, 8-bit (4/word)
+    # otherwise — for EVERY lane layout; the kernels parameterize on
+    # `bits` throughout. Deriving from num_bin rather than bins.max()
+    # means a split threshold in the (possibly data-empty) upper bin
+    # range is always representable in-width.
     bmax = max(int(bins.max(initial=0)), max_bin - 1)
-    if compact and bmax < 16:
+    if bmax < 16:
         bits = 4
-    elif compact and bmax < 64:
+    elif bmax < 64:
         bits = 6
     else:
         bits = 8
@@ -233,6 +257,27 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
 # ---------------------------------------------------------------------------
 # move pass
 # ---------------------------------------------------------------------------
+def _unpack_bundle(binv, r2):
+    """EFB: BUNDLE column value -> the split feature's own bin — MUST
+    stay bit-identical to ops/partition.bundle_unpack (the valid-set
+    walker and fused partition path route through that helper;
+    tests/test_efb.py::test_kernel_unpack_matches_bundle_unpack pins the
+    equivalence over the full domain). This arithmetic-select form
+    exists because Mosaic cannot broadcast the scalar bpk bool into a
+    vector select (arith.trunci to i1 fails in-kernel). r2 packs the
+    feature-space default_bin/num_bin plus boff/bpk. Must run BEFORE
+    _cat_word/_goes_left — both consume feature-space bins."""
+    db = r2 & 511
+    nb = (r2 >> 9) & 511
+    boff = (r2 >> 18) & 255
+    bpk = (r2 >> 27) & 1
+    p = binv - boff
+    in_range = ((p >= 0) & (p < nb - 1)).astype(jnp.int32)
+    b = jnp.where(p >= db, p + 1, p)
+    unpacked = in_range * b + (1 - in_range) * db
+    return bpk * unpacked + (1 - bpk) * binv
+
+
 def _goes_left(binv, r1, r2, valid, catw=None):
     """Reference DenseBin::Split routing (dense_bin.hpp:195-283):
     numerical with missing None/Zero/NaN, categorical by bitset
@@ -246,8 +291,8 @@ def _goes_left(binv, r1, r2, valid, catw=None):
     dl = (r1 >> R_DL) & 1                      # scalar 0/1
     mt = (r1 >> R_MT) & 3
     copy = (r1 >> R_COPY) & 1
-    db = r2 & 0xFFFF
-    nb = (r2 >> 16) & 0xFFFF
+    db = r2 & 511
+    nb = (r2 >> 9) & 511
     base = (binv <= thr).astype(jnp.int32)     # vector 0/1
     mtz = jnp.int32(0) + ((mt == MISSING_ZERO_C).astype(jnp.int32))
     mtn = (mt == MISSING_NAN_C).astype(jnp.int32)
@@ -408,7 +453,7 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  out_ref, hist_ref, stag,
                  fbuf, hacc, cur_ref, sems, *, chunk, w_pad, w_used, wcnt,
                  num_features, b_pad, group, dummy, bag_lane,
-                 bits, grad_fn, num_class, gh_off):
+                 bits, grad_fn, num_class, gh_off, bundled):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
@@ -528,6 +573,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         for wj in range(1, wcnt):
             word = jnp.where(wsel == wj, rec[wj, :], word)
         binv = (word >> ((r1 >> R_SHIFT) & 31)) & bmask
+        if bundled:
+            binv = _unpack_bundle(binv, r2_ref[i])
         catw = _cat_word(cbits_ref, hs & 0xFFFFFF, binv)
         left = _goes_left(binv, r1, r2_ref[i], valid, catw)
 
@@ -664,11 +711,12 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
     "group", "bag_lane", "bits", "grad_fn", "num_class", "w_used",
-    "gh_off", "interpret"))
+    "gh_off", "bundled", "interpret"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
               chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
               bag_lane=-1, bits=8, grad_fn=None, num_class=1,
-              w_used=0, gh_off=2, interpret=False):
+              w_used=0, gh_off=2, bundled=False,
+              interpret=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -701,7 +749,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
                                b_pad=b_pad, group=group, dummy=dummy,
                                bag_lane=bag_lane, bits=bits,
                                grad_fn=grad_fn, num_class=num_class,
-                               gh_off=gh_off)
+                               gh_off=gh_off, bundled=bundled)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     # copy chunks SKIP the blocked fetch: the block index carries the
@@ -752,8 +800,9 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
 # ---------------------------------------------------------------------------
 # physical left-count pass
 # ---------------------------------------------------------------------------
-def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
-                  rec_ref, out_ref, cacc, *, chunk, dummy, bits):
+def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref,
+                  cbits_ref, rec_ref, out_ref, cacc, *, chunk, dummy,
+                  bits, bundled):
     """Exact i32 count of PHYSICAL rows routed left per selected split.
 
     Streams only each block's split-word sublane (4 B/row). Needed when
@@ -783,6 +832,8 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
             word = jnp.where(wsub == wj, rec_ref[0, wj], word)
         r1 = r1_ref[i]
         binv = (word >> ((r1 >> R_SHIFT) & 31)) & ((1 << bits) - 1)
+        if bundled:
+            binv = _unpack_bundle(binv, r2_ref[i])
         pos = lax.broadcasted_iota(jnp.int32, (1, chunk), 1)[0]
         valid = pos < (meta & ((1 << 20) - 1))
         catw = _cat_word(cbits_ref, ks_ref[i], binv)
@@ -795,9 +846,10 @@ def _count_kernel(r1_ref, r2_ref, meta_ref, wsel_ref, ks_ref, cbits_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "chunk",
-                                             "bits", "interpret"))
+                                             "bits", "bundled",
+                                             "interpret"))
 def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
-               chunk, bits=8, interpret=False):
+               chunk, bits=8, bundled=False, interpret=False):
     """[num_slots] i32 physical left counts per compact slot id.
 
     kslots[i] = compact id of chunk i's selected split (num_slots =
@@ -806,7 +858,8 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
     nc = records.shape[0]
     w_pad = records.shape[1]
     kernel = functools.partial(_count_kernel, chunk=chunk,
-                               dummy=num_slots, bits=bits)
+                               dummy=num_slots, bits=bits,
+                               bundled=bundled)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(nc,),

@@ -145,3 +145,64 @@ def test_allstate_shaped_wide_sparse_fits_hbm():
         bst.update()
     p = bst.predict(Xs[:2000])
     assert np.isfinite(p).all()
+
+
+def test_bundled_aligned_matches_bundled_leafwise():
+    """EFB bundles on the ALIGNED path (round 5): records pack the
+    bundled storage columns, routing unpacks bundle -> feature bin
+    in-kernel, histograms expand at eval only. Must reproduce the
+    fused leaf-wise builder's trees on the same bundled dataset."""
+    X, y = _sparse_data()
+    preds = {}
+    for mode in ("aligned", "leafwise"):
+        params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                  "learning_rate": 0.2, "verbosity": -1,
+                  "enable_bundle": True, "tpu_grow_mode": mode,
+                  "tpu_aligned_interpret": mode == "aligned"}
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(6):
+            bst.update()
+        if mode == "aligned":
+            eng = bst._gbdt._aligned_eng_ref
+            assert eng is not None, "aligned engine not engaged"
+            assert bst._gbdt.learner.bundled
+            assert getattr(eng, "fallbacks", 0) == 0
+        preds[mode] = bst.predict(X[:800], raw_score=True)
+    np.testing.assert_allclose(preds["aligned"], preds["leafwise"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bundled_aligned_valid_walker():
+    """The aligned device walker unpacks bundled valid-set bins."""
+    X, y = _sparse_data()
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "verbosity": -1, "metric": "auc", "enable_bundle": True,
+              "tpu_grow_mode": "aligned", "tpu_aligned_interpret": True}
+    ds = lgb.Dataset(X[:3000], label=y[:3000], params=params).construct()
+    vs = lgb.Dataset(X[3000:], label=y[3000:], params=params,
+                     reference=ds).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.add_valid(vs, "v")
+    for _ in range(6):
+        bst.update()
+    out = bst.eval_valid()
+    assert out and np.isfinite(out[0][2]) and out[0][2] > 0.6
+
+
+def test_kernel_unpack_matches_bundle_unpack():
+    """The move/count kernels' arithmetic-select bundle unpack
+    (ops/aligned._unpack_bundle, Mosaic-safe form) must stay
+    bit-identical to ops/partition.bundle_unpack (the walker / fused
+    partition form) over the full parameter domain."""
+    import itertools
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.aligned import _unpack_bundle
+    from lightgbm_tpu.ops.partition import bundle_unpack
+    raw = jnp.arange(64, dtype=jnp.int32)
+    for boff, bpk, db, nb in itertools.product(
+            (0, 1, 5, 40), (0, 1), (0, 2, 7), (2, 5, 20)):
+        r2 = db | (nb << 9) | (boff << 18) | (bpk << 27)
+        a = np.asarray(_unpack_bundle(raw, jnp.int32(r2)))
+        b = np.asarray(bundle_unpack(raw, boff, bpk, db, nb))
+        np.testing.assert_array_equal(a, b, err_msg=str((boff, bpk, db, nb)))

