@@ -498,9 +498,20 @@ class Config:
     @property
     def forces_host_learner(self) -> bool:
         """True when config alone routes training to the host
-        SerialTreeLearner (forced splits / CEGB are implemented there,
-        serial_learner.py). GBDT.use_fused and Dataset._maybe_bundle
-        must agree on this, so it lives in one place."""
+        SerialTreeLearner. Forced splits and CEGB split/coupled
+        penalties run on the fused DEVICE learner (round 5); only the
+        per-(row, feature) LAZY penalties keep the host twin (their
+        marking state has no bounded device representation).
+        GBDT.use_fused and Dataset._maybe_bundle must agree on this, so
+        it lives in one place."""
+        return len(self.cegb_penalty_feature_lazy) > 0
+
+    @property
+    def sequential_device_only(self) -> bool:
+        """True when the config needs the strictly SEQUENTIAL device
+        tree loop (fused builder): forced splits and CEGB penalties
+        depend on commit order, which the speculative aligned/level
+        engines replay out of order."""
         return bool(self.forcedsplits_filename) \
             or self.cegb_penalty_split > 0 \
             or len(self.cegb_penalty_feature_coupled) > 0 \

@@ -294,6 +294,7 @@ class DeviceTreeLearner:
         selects the aligned pipeline or leafwise — the sort-based level
         builder stays opt-in (measured on par with leafwise on v5e)."""
         return (self.cfg.tpu_grow_mode == "level"
+                and not self.cfg.sequential_device_only
                 and not self.bundled
                 and self.parallel_mode in ("serial", "data")
                 and self.ds.bins is not None
@@ -450,6 +451,88 @@ class DeviceTreeLearner:
         depth_limit = self._depth_limit
         mono_dev = jnp.asarray(self.meta["monotone"], jnp.int32)
 
+        # ---- CEGB on the device path (reference CalculateOndemandCosts,
+        # serial_tree_learner.cpp:488-568): split penalty scales with the
+        # leaf's (global) row count; coupled penalties charge a feature
+        # once per model, tracked by a [F] used-mask carried through the
+        # tree loop. Per-(row, feature) LAZY penalties keep the host twin
+        # (forces_host_learner).
+        cegb_on = (cfg.cegb_penalty_split > 0
+                   or len(cfg.cegb_penalty_feature_coupled) > 0)
+        cegb_coupled_on = len(cfg.cegb_penalty_feature_coupled) > 0
+        cegb_tr = float(cfg.cegb_tradeoff)
+        cegb_sp = float(cfg.cegb_penalty_split) * cegb_tr
+        # coupled penalties charge a feature once per MODEL: features
+        # used by EARLIER trees arrive zeroed in the per-call
+        # coupled_eff array (see _cegb_coupled_eff / _cegb_note_record);
+        # the in-loop used-mask handles this tree's own first uses
+        assert not (cegb_coupled_on and self.parallel_mode != "serial"), \
+            "coupled CEGB routes to the host twin off the serial learner"
+
+        # ---- forced splits (reference ForceSplits, serial_tree_learner
+        # .cpp:597-755): the JSON prefix flattens to node arrays; a BFS
+        # queue rides the tree-loop state, each pop overriding the
+        # gain-driven leaf/split choice with the node's (feature,
+        # threshold) evaluated AT-threshold from the leaf histogram
+        # (GatherInfoForThreshold, feature_histogram.hpp:290+). A node
+        # whose forced threshold leaves an empty child is skipped like
+        # the host twin does.
+        fnodes = self._forced_nodes()
+        MF = len(fnodes)
+        MFq = max(MF, 1)
+        fF_dev = jnp.asarray([x[0] for x in fnodes] or [0], jnp.int32)
+        fT_dev = jnp.asarray([x[1] for x in fnodes] or [0], jnp.int32)
+        fL_dev = jnp.asarray([x[2] for x in fnodes] or [-1], jnp.int32)
+        fR_dev = jnp.asarray([x[3] for x in fnodes] or [-1], jnp.int32)
+        l1_hp = float(self.hyper.lambda_l1)
+        l2_hp = float(self.hyper.lambda_l2)
+
+        def forced_info(ph, sg, sh, cntg, f, thr):
+            """BF/BI payload rows for a forced split AT (f, thr) from the
+            parent's [F, B, 3] histogram — mirrors the host twin's
+            _forced_split_info bit-for-bit in f32."""
+            row = ph[f]                                     # [B, 3]
+            nbf = nb_dev[f]
+            hi = jnp.minimum(thr + 1, nbf)
+            m = (jnp.arange(B, dtype=jnp.int32) < hi)[:, None]
+            sums = jnp.sum(jnp.where(m, row, 0.0), axis=0)
+            lg, lh, lcf = sums[0], sums[1], sums[2]
+            nan_adj = (mt_dev[f] == 2) & (hi > nbf - 1)
+            last = row[jnp.clip(nbf - 1, 0, B - 1)]
+            lg = lg - jnp.where(nan_adj, last[0], 0.0)
+            lh = lh - jnp.where(nan_adj, last[1], 0.0)
+            lcf = lcf - jnp.where(nan_adj, last[2], 0.0)
+            lc = jnp.round(lcf).astype(jnp.int32)
+            rg, rh = sg - lg, sh - lh
+            rc = cntg - lc
+
+            def tl1(sv):
+                return jnp.sign(sv) * jnp.maximum(jnp.abs(sv) - l1_hp, 0.0)
+
+            def pgain(sv, hv):
+                return jnp.where(hv + l2_hp > 0,
+                                 tl1(sv) ** 2 / (hv + l2_hp), 0.0)
+
+            def outp(sv, hv):
+                return jnp.where(hv + l2_hp > 0,
+                                 -tl1(sv) / (hv + l2_hp), 0.0)
+
+            gain = pgain(lg, lh) + pgain(rg, rh) - pgain(sg, sh)
+            vF = jnp.zeros(BF_W, jnp.float32)
+            vF = vF.at[BF_GAIN].set(gain)
+            vF = vF.at[BF_LG].set(lg)
+            vF = vF.at[BF_LH].set(lh)
+            vF = vF.at[BF_RG].set(rg)
+            vF = vF.at[BF_RH].set(rh)
+            vF = vF.at[BF_LOUT].set(outp(lg, lh))
+            vF = vF.at[BF_ROUT].set(outp(rg, rh))
+            vI = jnp.zeros(BI_W, jnp.int32)
+            vI = vI.at[BI_FEAT].set(f)
+            vI = vI.at[BI_THR].set(thr)
+            vI = vI.at[BI_LC].set(lc)
+            vI = vI.at[BI_RC].set(rc)
+            return vF, vI
+
         mode = self.parallel_mode
         nd = self.mesh_size if mode == "feature" else 1
         f_block = F // nd if mode == "feature" else F
@@ -540,7 +623,10 @@ class DeviceTreeLearner:
         per_shard_rows = (int(math.ceil(self.n / max(self.mesh_size, 1)))
                           if rows_sharded else self.n)
 
-        def build_fresh(bins, bins_T, grad, hess, feature_mask_f32):
+        coupled_box = [jnp.zeros((F,), jnp.float32)]
+
+        def build_fresh(bins, bins_T, grad, hess, feature_mask_f32,
+                        coupled_eff=None):
             """Fresh-tree entry: creates the identity partition internally
             (one fused program instead of init-partition + build
             dispatches); only valid without bagging."""
@@ -555,15 +641,19 @@ class DeviceTreeLearner:
                 cnt = jnp.int32(per_shard_rows)
             indices = jnp.where(pos < cnt, pos, 0)
             gh = jnp.stack([grad, hess], axis=1)
-            return _build(bins, bins_T, indices, gh, cnt, feature_mask_f32)
+            return _build(bins, bins_T, indices, gh, cnt, feature_mask_f32,
+                          coupled_eff)
 
         def build(bins, bins_T, indices, grad, hess, root_count,
-                  feature_mask_f32):
+                  feature_mask_f32, coupled_eff=None):
             gh = jnp.stack([grad, hess], axis=1)
             return _build(bins, bins_T, indices, gh, root_count,
-                          feature_mask_f32)
+                          feature_mask_f32, coupled_eff)
 
-        def _build(bins, bins_T, indices, gh, root_count, feature_mask_f32):
+        def _build(bins, bins_T, indices, gh, root_count, feature_mask_f32,
+                   coupled_eff=None):
+            if cegb_coupled_on:
+                coupled_box[0] = coupled_eff
 
             def _mask_gain(gain, depth):
                 gain = jnp.where(feature_mask_f32 > 0, gain, NEG_INF)
@@ -572,12 +662,20 @@ class DeviceTreeLearner:
 
             _payload = pack_best_payload
 
+            def _cegb_pen(cnt, used, coupled_eff):
+                """Per-feature CEGB gain penalty for one leaf."""
+                pen = cegb_sp * cnt.astype(jnp.float32)
+                if cegb_coupled_on:
+                    pen = pen + coupled_eff * (1.0 - used)
+                return pen
+
             if mode == "voting":
                 # PV-Tree (reference voting_parallel_tree_learner.cpp:
                 # 262-400): local top-k vote -> global vote -> reduce only
                 # the elected features' histograms -> global best split.
                 # `hist` here is this shard's LOCAL histogram of the leaf.
-                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth,
+                              used=None):
                     # local leaf sums: every row lands in exactly one bin of
                     # feature 0, so its histogram column sums to the local
                     # totals (no FixHistogram-style bin skipping here)
@@ -598,13 +696,19 @@ class DeviceTreeLearner:
                     out = finder(ghist, sg, sh, cnt, minc, maxc)
                     selmask = jnp.zeros((F,), bool).at[sel_idx].set(True)
                     gain = jnp.where(selmask, out["gain"], NEG_INF)
+                    if cegb_on:
+                        gain = gain - _cegb_pen(cnt, used, coupled_box[0])
                     return _payload(out, _mask_gain(gain, depth))
             else:
-                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth):
+                def eval_leaf(hist, sg, sh, cnt, minc, maxc, depth,
+                              used=None):
                     if bundled:
                         hist = expand_hist(hist, sg, sh, cnt)
                     out = finder(hist, sg, sh, cnt, minc, maxc)
-                    return _payload(out, _mask_gain(out["gain"], depth))
+                    gain = out["gain"]
+                    if cegb_on:
+                        gain = gain - _cegb_pen(cnt, used, coupled_box[0])
+                    return _payload(out, _mask_gain(gain, depth))
 
             # ---------- root ----------
             if root_contiguous:
@@ -674,29 +778,87 @@ class DeviceTreeLearner:
             recI = jnp.zeros((Lm1, RI_W), jnp.int32)
             recB = jnp.zeros((Lm1, 8), jnp.uint32)
 
+            used0 = jnp.zeros((F,), jnp.float32)
             rvF, rvI, rvB = eval_leaf(
                 root_hist, root_g, root_h, root_count_g,
-                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.int32(0))
+                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.int32(0),
+                used0)
             bestF = bestF.at[0].set(rvF)
             bestI = bestI.at[0].set(rvI)
             bestB = bestB.at[0].set(rvB)
 
+            # forced-split BFS queue (node 0 seeded at the root leaf) +
+            # CEGB used-feature mask ride the loop state; both are tiny
+            # and inert when the features are off
+            fq_leaf0 = jnp.zeros((MFq + 1,), jnp.int32)
+            fq_node0 = jnp.zeros((MFq + 1,), jnp.int32)
             state = (jnp.int32(0), indices, leafF, leafI, hist_store,
-                     bestF, bestI, bestB, recF, recI, recB)
+                     bestF, bestI, bestB, recF, recI, recB, used0,
+                     jnp.int32(0), jnp.int32(1 if MF else 0),
+                     fq_leaf0, fq_node0)
 
             def cond(state):
                 s = state[0]
                 bestF = state[5]
-                return (s < split_budget) & (jnp.max(bestF[:, BF_GAIN]) > 0.0)
+                forced_pending = state[12] < state[13]
+                return (s < split_budget) \
+                    & ((jnp.max(bestF[:, BF_GAIN]) > 0.0) | forced_pending)
 
             def body(state):
                 (s, indices, leafF, leafI, hist_store, bestF, bestI, bestB,
-                 recF, recI, recB) = state
+                 recF, recI, recB, used, fh, ft, fq_leaf, fq_node) = state
                 bl = jnp.argmax(bestF[:, BF_GAIN]).astype(jnp.int32)
                 new_leaf = s + 1
+                act = jnp.bool_(True)
+                forced_mode = jnp.bool_(False)
+                nid = jnp.int32(0)
+                if MF:
+                    # pop the BFS queue ahead of gain-driven selection
+                    # (ForceSplits runs before normal growth)
+                    forced_mode = fh < ft
+                    qp = jnp.clip(fh, 0, MFq)
+                    nid = jnp.clip(fq_node[qp], 0, MF - 1)
+                    bl = jnp.where(forced_mode, fq_leaf[qp], bl)
                 bF = bestF[bl]
                 bI = bestI[bl]
                 bB = bestB[bl]
+                if MF:
+                    # AT-threshold split info from the parent histogram,
+                    # under lax.cond so split iterations after the queue
+                    # drains skip the (possibly recomputed) histogram
+
+                    def _forced_payload(_):
+                        sgp = leafF[bl, LF_SG]
+                        shp = leafF[bl, LF_SH]
+                        cntp = leafI[bl, LI_COUNTG]
+                        if pool_recompute:
+                            bkp = self._bucket_index(leafI[bl, LI_COUNT],
+                                                     buckets)
+                            ph = lax.switch(bkp, hist_fns, bins, indices,
+                                            gh, leafI[bl, LI_BEGIN],
+                                            leafI[bl, LI_COUNT])
+                            ph = _gsum_hist(ph)
+                        else:
+                            ph = hist_store[bl].astype(jnp.float32)
+                        if bundled:
+                            ph = expand_hist(ph, sgp, shp, cntp)
+                        return forced_info(ph, sgp, shp, cntp,
+                                           fF_dev[nid], fT_dev[nid])
+
+                    def _no_payload(_):
+                        return (jnp.zeros(BF_W, jnp.float32),
+                                jnp.zeros(BI_W, jnp.int32))
+
+                    fvF, fvI = lax.cond(forced_mode, _forced_payload,
+                                        _no_payload, operand=None)
+                    bF = jnp.where(forced_mode, fvF, bF)
+                    bI = jnp.where(forced_mode, fvI, bI)
+                    bB = jnp.where(forced_mode, jnp.zeros_like(bB), bB)
+                    # a forced threshold that empties a child is skipped
+                    # (host twin: min(left_c, right_c) < 1 -> continue)
+                    act = jnp.where(
+                        forced_mode,
+                        jnp.minimum(fvI[BI_LC], fvI[BI_RC]) >= 1, True)
                 f = bI[BI_FEAT]
                 thr = bI[BI_THR]
                 dleft = bI[BI_DEFLEFT] != 0
@@ -735,9 +897,9 @@ class DeviceTreeLearner:
                 rowI = rowI.at[RI_ISCAT].set(bI[BI_ISCAT])
                 rowI = rowI.at[RI_LC].set(left_cnt_g)
                 rowI = rowI.at[RI_RC].set(right_cnt_g)
-                recF = recF.at[s].set(rowF)
-                recI = recI.at[s].set(rowI)
-                recB = recB.at[s].set(bB)
+                recF = recF.at[s].set(jnp.where(act, rowF, recF[s]))
+                recI = recI.at[s].set(jnp.where(act, rowI, recI[s]))
+                recB = recB.at[s].set(jnp.where(act, bB, recB[s]))
 
                 # ---- children bookkeeping (two packed-row writes)
                 depth = leafI[bl, LI_DEPTH] + 1
@@ -766,16 +928,18 @@ class DeviceTreeLearner:
                 rrowF = rrowF.at[LF_MINC].set(rmin)
                 rrowF = rrowF.at[LF_MAXC].set(rmax)
                 rrowF = rrowF.at[LF_VALUE].set(bF[BF_ROUT])
-                leafF = leafF.at[bl].set(lrowF)
-                leafF = leafF.at[new_leaf].set(rrowF)
+                leafF = leafF.at[bl].set(jnp.where(act, lrowF, leafF[bl]))
+                leafF = leafF.at[new_leaf].set(
+                    jnp.where(act, rrowF, leafF[new_leaf]))
                 lrowI = jnp.stack([begin, left_cnt, left_cnt_g, depth,
                                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
                                    jnp.int32(0)])
                 rrowI = jnp.stack([begin + left_cnt, right_cnt, right_cnt_g,
                                    depth, jnp.int32(0), jnp.int32(0),
                                    jnp.int32(0), jnp.int32(0)])
-                leafI = leafI.at[bl].set(lrowI)
-                leafI = leafI.at[new_leaf].set(rrowI)
+                leafI = leafI.at[bl].set(jnp.where(act, lrowI, leafI[bl]))
+                leafI = leafI.at[new_leaf].set(
+                    jnp.where(act, rrowI, leafI[new_leaf]))
 
                 # histogram the smaller child (by GLOBAL counts, so every
                 # shard histograms the same child); larger = parent - smaller
@@ -805,28 +969,67 @@ class DeviceTreeLearner:
                 left_hist = jnp.where(smaller_is_left, sm_hist, lg_hist)
                 right_hist = jnp.where(smaller_is_left, lg_hist, sm_hist)
                 if not pool_recompute:
-                    hist_store = hist_store.at[bl].set(
-                        left_hist.astype(hist_store.dtype))
-                    hist_store = hist_store.at[new_leaf].set(
-                        right_hist.astype(hist_store.dtype))
+                    hist_store = hist_store.at[bl].set(jnp.where(
+                        act, left_hist.astype(hist_store.dtype),
+                        hist_store[bl]))
+                    hist_store = hist_store.at[new_leaf].set(jnp.where(
+                        act, right_hist.astype(hist_store.dtype),
+                        hist_store[new_leaf]))
+
+                # CEGB: the committed split's feature becomes "used"
+                # (coupled penalty drops to zero from here on)
+                if cegb_on:
+                    used = used.at[f].set(jnp.where(act, 1.0, used[f]))
 
                 # evaluate both children (global counts)
                 lF, lI, lB = eval_leaf(left_hist, bF[BF_LG], bF[BF_LH],
-                                       left_cnt_g, lmin, lmax, depth)
+                                       left_cnt_g, lmin, lmax, depth,
+                                       used)
                 rF, rI, rB = eval_leaf(right_hist, bF[BF_RG], bF[BF_RH],
-                                       right_cnt_g, rmin, rmax, depth)
-                bestF = bestF.at[bl].set(lF)
-                bestF = bestF.at[new_leaf].set(rF)
-                bestI = bestI.at[bl].set(lI)
-                bestI = bestI.at[new_leaf].set(rI)
-                bestB = bestB.at[bl].set(lB)
-                bestB = bestB.at[new_leaf].set(rB)
+                                       right_cnt_g, rmin, rmax, depth,
+                                       used)
+                bestF = bestF.at[bl].set(jnp.where(act, lF, bestF[bl]))
+                bestF = bestF.at[new_leaf].set(
+                    jnp.where(act, rF, bestF[new_leaf]))
+                bestI = bestI.at[bl].set(jnp.where(act, lI, bestI[bl]))
+                bestI = bestI.at[new_leaf].set(
+                    jnp.where(act, rI, bestI[new_leaf]))
+                bestB = bestB.at[bl].set(jnp.where(act, lB, bestB[bl]))
+                bestB = bestB.at[new_leaf].set(
+                    jnp.where(act, rB, bestB[new_leaf]))
 
-                return (s + 1, new_indices, leafF, leafI, hist_store,
-                        bestF, bestI, bestB, recF, recI, recB)
+                if MF:
+                    # advance the queue: pop, and push surviving children
+                    # left-then-right (host BFS order); the left child
+                    # keeps leaf bl, the right child is new_leaf
+                    acti = act.astype(jnp.int32)
+                    nl = fL_dev[nid]
+                    nr = fR_dev[nid]
+                    p1 = forced_mode & act & (nl >= 0)
+                    t1 = jnp.clip(ft, 0, MFq)
+                    fq_leaf = fq_leaf.at[t1].set(
+                        jnp.where(p1, bl, fq_leaf[t1]))
+                    fq_node = fq_node.at[t1].set(
+                        jnp.where(p1, nl, fq_node[t1]))
+                    ft = ft + p1.astype(jnp.int32)
+                    p2 = forced_mode & act & (nr >= 0)
+                    t2 = jnp.clip(ft, 0, MFq)
+                    fq_leaf = fq_leaf.at[t2].set(
+                        jnp.where(p2, new_leaf, fq_leaf[t2]))
+                    fq_node = fq_node.at[t2].set(
+                        jnp.where(p2, nr, fq_node[t2]))
+                    ft = ft + p2.astype(jnp.int32)
+                    fh = fh + forced_mode.astype(jnp.int32)
+                else:
+                    acti = 1
+
+                return (s + acti, new_indices, leafF, leafI, hist_store,
+                        bestF, bestI, bestB, recF, recI, recB, used,
+                        fh, ft, fq_leaf, fq_node)
 
             (n_splits, indices, leafF, leafI, hist_store, bestF, bestI,
-             bestB, recF, recI, recB) = lax.while_loop(cond, body, state)
+             bestB, recF, recI, recB, _used, _fh, _ft, _fql, _fqn) = \
+                lax.while_loop(cond, body, state)
 
             record = TreeRecord(
                 num_splits=n_splits,
@@ -860,6 +1063,9 @@ class DeviceTreeLearner:
         categorical features, with or without bagging (round 4)."""
         mode = self.cfg.tpu_grow_mode
         if mode not in ("auto", "aligned"):
+            return False
+        if self.cfg.sequential_device_only:
+            # forced splits / CEGB need the sequential fused loop
             return False
         from ..ops.aligned import aligned_available
         if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
@@ -931,6 +1137,39 @@ class DeviceTreeLearner:
         self._aligned_eng = None
 
     # ------------------------------------------------------------------
+    def _forced_nodes(self):
+        """Forced-splits JSON flattened to (used_feature, threshold_bin,
+        left_node, right_node) tuples (indices into the list; -1 = no
+        child). Nodes on unused features drop with their subtrees, like
+        the host twin (serial_learner._apply_forced_splits)."""
+        if not self.cfg.forcedsplits_filename:
+            return []
+        import json as _json
+        with open(self.cfg.forcedsplits_filename) as fh:
+            root = _json.load(fh)
+        out = []
+
+        def flat(node):
+            if not isinstance(node, dict) or "feature" not in node:
+                return -1
+            real_f = int(node["feature"])
+            fmap = self.ds.used_feature_map
+            f = int(fmap[real_f]) if real_f < len(fmap) else -1
+            if f < 0:
+                return -1
+            idx = len(out)
+            out.append(None)
+            thr = int(self.mappers[f].values_to_bins(
+                np.asarray([float(node["threshold"])]))[0])
+            lft = flat(node.get("left"))
+            rgt = flat(node.get("right"))
+            out[idx] = (f, thr, lft, rgt)
+            return idx
+
+        flat(root)
+        return out
+
+    # ------------------------------------------------------------------
     def init_root_partition(self, bag_indices, bag_cnt: int):
         """Fresh root partition for one boosting iteration (the analogue of
         `DataPartition::Init`, data_partition.hpp:59)."""
@@ -946,6 +1185,34 @@ class DeviceTreeLearner:
             return jnp.ones(self.num_features, jnp.float32)
         return jnp.asarray(feature_mask.astype(np.float32))
 
+    # -- coupled-CEGB per-model state -----------------------------------
+    @property
+    def _cegb_coupled_on(self) -> bool:
+        return len(self.cfg.cegb_penalty_feature_coupled) > 0
+
+    def _cegb_coupled_eff(self) -> jax.Array:
+        """Per-call coupled penalties with already-used features zeroed
+        (the host mirror of the reference's once-per-model charge)."""
+        if getattr(self, "_cegb_used_np", None) is None:
+            self._cegb_used_np = np.zeros(self.num_features, bool)
+        arr = np.asarray(self.cfg.cegb_penalty_feature_coupled, np.float64)
+        real = np.asarray(self.ds.real_feature_idx)
+        cp = np.zeros(self.num_features, np.float32)
+        cp[:len(real)] = arr[real] * float(self.cfg.cegb_tradeoff)
+        cp[self._cegb_used_np] = 0.0
+        return jnp.asarray(cp)
+
+    def _cegb_note_record(self, rec: TreeRecord) -> None:
+        """Mark the tree's committed split features used (one small
+        device pull; only coupled-CEGB configs pay it)."""
+        if not self._cegb_coupled_on:
+            return
+        k = int(rec.num_splits)
+        feats = np.asarray(rec.feature)[:k]
+        if getattr(self, "_cegb_used_np", None) is None:
+            self._cegb_used_np = np.zeros(self.num_features, bool)
+        self._cegb_used_np[feats] = True
+
     def train(self, grad: jax.Array, hess: jax.Array,
               indices: jax.Array, root_count: int,
               feature_mask: Optional[np.ndarray] = None
@@ -959,8 +1226,13 @@ class DeviceTreeLearner:
         if fn is None:
             fn = self._make_build_fn(root_padded, False)
             self._build_cache[key] = fn
-        return fn(self.bins_dev, self.bins_T_dev, indices, grad, hess,
-                  jnp.int32(root_count), self._fmask_arr(feature_mask))
+        args = [self.bins_dev, self.bins_T_dev, indices, grad, hess,
+                jnp.int32(root_count), self._fmask_arr(feature_mask)]
+        if self._cegb_coupled_on:
+            args.append(self._cegb_coupled_eff())
+        idxs, rec = fn(*args)
+        self._cegb_note_record(rec) if self._cegb_coupled_on else None
+        return idxs, rec
 
     def train_fresh(self, grad: jax.Array, hess: jax.Array,
                     feature_mask: Optional[np.ndarray] = None
@@ -978,8 +1250,14 @@ class DeviceTreeLearner:
         if fn is None:
             fn = self._make_build_fn(root_padded, True)
             self._build_cache[key] = fn
-        return fn(self.bins_dev, self.bins_T_dev, grad, hess,
-                  self._fmask_arr(feature_mask))
+        args = [self.bins_dev, self.bins_T_dev, grad, hess,
+                self._fmask_arr(feature_mask)]
+        if self._cegb_coupled_on:
+            args.append(self._cegb_coupled_eff())
+        idxs, rec = fn(*args)
+        if self._cegb_coupled_on:
+            self._cegb_note_record(rec)
+        return idxs, rec
 
     def train_iter_fused(self, score: jax.Array, objective, scale: float,
                          feature_mask: Optional[np.ndarray] = None
@@ -1003,11 +1281,14 @@ class DeviceTreeLearner:
         if fn is None:
             build = self._make_build_fn(root_padded, True)
 
-            def step(score, scale, fmask):
+            def step(score, scale, fmask, coupled_eff=None):
                 gdev, hdev = objective.gradients_impl(score)
                 # nested jitted calls inline into this trace
-                indices, rec = build(self.bins_dev, self.bins_T_dev,
-                                     gdev[0], hdev[0], fmask)
+                bargs = [self.bins_dev, self.bins_T_dev, gdev[0],
+                         hdev[0], fmask]
+                if self._cegb_coupled_on:
+                    bargs.append(coupled_eff)
+                indices, rec = build(*bargs)
                 new_score = _partition_score_update(
                     score, jnp.int32(0), rec.leaf_begin,
                     rec.leaf_cnt_part, rec.leaf_value, indices,
@@ -1016,7 +1297,13 @@ class DeviceTreeLearner:
 
             fn = jax.jit(step, donate_argnums=(0,))
             self._build_cache[key] = fn
-        return fn(score, jnp.float32(scale), self._fmask_arr(feature_mask))
+        args = [score, jnp.float32(scale), self._fmask_arr(feature_mask)]
+        if self._cegb_coupled_on:
+            args.append(self._cegb_coupled_eff())
+        out = fn(*args)
+        if self._cegb_coupled_on:
+            self._cegb_note_record(out[2])
+        return out
 
     def _level_iter_fused(self, score, objective, scale, feature_mask):
         """Level-mode iteration: program A traces gradients + speculative

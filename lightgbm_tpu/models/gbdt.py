@@ -150,12 +150,20 @@ class GBDT:
         self.iter = 0
         # fused on-device learner when the objective has no host-side leaf
         # renewal hook; host-driven serial learner otherwise
+        # voting-parallel forced splits would read LOCAL histograms
+        # against GLOBAL totals, and coupled-CEGB state is serial-only:
+        # both route to the host twin (the reference's own learner)
+        seq_host = ((bool(cfg.forcedsplits_filename)
+                     and cfg.tree_learner == "voting")
+                    or (len(cfg.cegb_penalty_feature_coupled) > 0
+                        and cfg.tree_learner != "serial"))
         self.use_fused = (
             self._fused_ok
             and not (self.objective is not None
                      and getattr(self.objective, "is_renew_tree_output",
                                  False))
             and not cfg.forces_host_learner
+            and not seq_host
             and cfg.tree_learner in ("serial", "data", "feature", "voting"))
         if self.use_fused:
             if cfg.tree_learner == "serial" or len(jax.devices()) == 1:

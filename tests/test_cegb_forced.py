@@ -144,3 +144,70 @@ def test_histogram_pool_tiny_budget_recompute():
     pa = bt.predict(X[:400])
     pb = bf.predict(X[:400])
     np.testing.assert_allclose(pa, pb, rtol=1e-4, atol=1e-5)
+
+
+def _preds_host(params, X, y, rounds=6):
+    """Force the host SerialTreeLearner (oracle) for the same config."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    old = GBDT._fused_ok
+    GBDT._fused_ok = False
+    try:
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(rounds):
+            bst.update()
+        return bst.predict(X, raw_score=True)
+    finally:
+        GBDT._fused_ok = old
+
+
+def _preds_dev(params, X, y, rounds=6):
+    from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    assert isinstance(bst._gbdt.learner, DeviceTreeLearner), \
+        "config no longer routes to the device learner"
+    for _ in range(rounds):
+        bst.update()
+    return bst.predict(X, raw_score=True)
+
+
+def test_device_cegb_matches_host_oracle():
+    """Split + coupled CEGB penalties on the fused DEVICE learner agree
+    with the host twin (oracle) to float-precision tolerance — the same
+    tolerance class as every device/host comparison here (f32 device
+    histograms vs the twin's f64 can flip near-tie split order)."""
+    X, y = _data()
+    for case in ({"cegb_penalty_feature_coupled": [5, 10, 1, 2.5, 3]},
+                 # LARGE coupled penalties: the once-per-MODEL charge is
+                 # load-bearing (without persistence trees 2+ re-pay the
+                 # open cost and stop splitting, diverging from the host)
+                 {"cegb_penalty_feature_coupled": [40, 40, 40, 40, 40]},
+                 {"cegb_penalty_split": 0.5}):
+        params = {"objective": "regression", "verbosity": -1,
+                  "num_leaves": 15, **case}
+        pd = _preds_dev(params, X, y)
+        ph = _preds_host(params, X, y)
+        d = np.abs(pd - ph)
+        assert d.mean() < 2e-3 and d.max() < 0.15, (case, d.mean(), d.max())
+
+
+def test_device_forced_matches_host_oracle():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((1500, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    forced = {"feature": 2, "threshold": 0.1,
+              "right": {"feature": 0, "threshold": 0.0}}
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as fh:
+        json.dump(forced, fh)
+        path = fh.name
+    try:
+        params = {"objective": "binary", "verbosity": -1,
+                  "num_leaves": 15, "forcedsplits_filename": path}
+        pd = _preds_dev(params, X, y)
+        ph = _preds_host(params, X, y)
+        d = np.abs(pd - ph)
+        assert d.mean() < 2e-3 and d.max() < 0.2, (d.mean(), d.max())
+    finally:
+        os.unlink(path)
