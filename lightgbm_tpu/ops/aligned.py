@@ -16,15 +16,11 @@ Record layout: `[NC, W, C] int32` — chunk-blocked and TRANSPOSED so rows
 sit in the 128-lane dimension (Mosaic only allows dynamic slicing at
 128-aligned lane offsets; with rows on lanes, whole chunks move as
 `ref.at[chunk]` DMAs and in-chunk permutations become matmuls). Lanes of
-one row live at the same lane index across the W sublanes:
-
-    0..wcnt-1 : packed bin words (4 uint8 bins per word, little-endian)
-    wcnt+0    : score   (f32 bits)
-    wcnt+1    : label   (f32 bits)
-    wcnt+2    : grad    (f32 bits)
-    wcnt+3    : hess    (f32 bits)
-    wcnt+4    : row id  (int32)
-    wcnt+5    : weight  (f32 bits, 1.0 when unweighted)
+one row live at the same lane index across the W sublanes; the first
+wcnt sublanes are packed bin words (4/5/8 bins per word at 8/6/4-bit
+widths — under EFB the columns are BUNDLE storage), the rest are the
+layout's value lanes (see `lane_layout`: STANDARD score/label/grad/
+hess/rid/weight, COMPACT score(+prob)/meta, EXT score/grad/hess/rid).
 
 Tree blocks own disjoint CHUNK-ALIGNED ranges of the record matrix, so
 every chunk belongs to exactly one block and per-chunk routing parameters
