@@ -373,11 +373,13 @@ class Config:
     tpu_grow_mode: str = "auto"
     # speculation slots as a multiple of num_leaves for the level/aligned
     # builders; larger values let the exact leaf-wise replay absorb more
-    # speculation churn before falling back. With the budget-capped
-    # replay, n_exec stays under ~2.4x num_leaves through 450+ iterations
-    # at HIGGS shape (max seen 608 at L=255); 3.0 leaves margin while
-    # keeping the S-sized per-round glue (eval/store/replay) small.
-    tpu_level_spec: float = 3.0
+    # speculation churn before falling back. LATE-training iterations
+    # speculate far more than early ones (gains converge and tie): a
+    # full 500-iteration HIGGS-shape run at 3.0 fell back 106 times
+    # after iteration ~100, while 4.5 measured ZERO fallbacks at both
+    # 63 and 255 bins for ~5% per-iteration glue cost. Lowering this
+    # trades that margin back for speed on short trainings.
+    tpu_level_spec: float = 4.5
     tpu_min_pad: int = 1024              # smallest padded leaf size (compile cache)
     tpu_chunk: int = 0                   # aligned rows/chunk (0 = auto)
     # run the aligned pipeline's Pallas kernels in interpret mode (CPU
