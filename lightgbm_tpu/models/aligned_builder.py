@@ -377,11 +377,9 @@ class AlignedEngine:
         # on wide-feature/high-bin shapes (e.g. F=137 at B=256 nibble
         # blocks would need 216 MB at K=256) — fewer splits per round,
         # more rounds, but the kernel still compiles
-        from ..ops.aligned import _hist_store_shape
+        from ..ops.aligned import slot_hist_bytes
         _bh = lr.hist_bins if lr.bundled else lr.max_bin_global
-        slot_bytes = 4 * int(np.prod(
-            _hist_store_shape(0, self.ncols, _bh,
-                              8 if _bh <= 64 else 4)[1:]))
+        slot_bytes = slot_hist_bytes(self.ncols, _bh)
         import os as _os
         kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0)
         if not kcap:

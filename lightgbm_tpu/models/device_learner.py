@@ -1109,13 +1109,28 @@ class DeviceTreeLearner:
                 # non-pointwise objectives pay a row-order gradient
                 # round-trip (materialize + gather); the ext record
                 # layout (round 5) plus the [K]-compact hist/eval path
-                # made this a win at the MSLR shape (2.27M x 137), so the
-                # gate is now just a floor where the round-trip
-                # amortizes; forced tpu_grow_mode=aligned bypasses it
+                # made this a win at the MSLR shape (2.27M x 137 at 63
+                # bins: 562 vs the fused 1264 ms/iter) — but only while
+                # the per-slot histogram block is small enough for a
+                # workable K (wide-F x 256-bin nibble blocks force K=64
+                # AND still blow VMEM: MSLR at 255 bins measured 2.06 s
+                # vs fused 1.26). Gate: a row floor where the
+                # round-trip amortizes plus the slot-block budget;
+                # forced tpu_grow_mode=aligned bypasses both.
                 and (objective.point_grad_fn() is not None
                      or objective.num_model_per_iteration > 1
-                     or self.n >= 1_000_000
+                     or (self.n >= 1_000_000
+                         and self._aligned_slot_bytes() <= (512 << 10))
                      or mode == "aligned"))
+
+    def _aligned_slot_bytes(self) -> int:
+        """Bytes of ONE slot's histogram block in the aligned engine's
+        VMEM-resident stores (shared with the K-cap driver)."""
+        from ..ops.aligned import slot_hist_bytes
+        bh = self.hist_bins if self.bundled else self.max_bin_global
+        ncols = (len(np.asarray(self.ds.bundles.group_num_bin))
+                 if self.bundled else self.num_features)
+        return slot_hist_bytes(ncols, bh)
 
     def aligned_engine(self, objective, init_row_scores=None,
                        bagged=False, num_class=1):

@@ -401,6 +401,15 @@ def _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C):
         accum(gi, contrib)
 
 
+def slot_hist_bytes(ncols: int, b_pad: int) -> int:
+    """Bytes of ONE slot's histogram block in the engine's VMEM-resident
+    stores — the single source of truth for the per-round split cap K
+    (aligned_builder) AND the non-pointwise routing gate
+    (device_learner.aligned_mode_ok)."""
+    group = 8 if b_pad <= 64 else 4
+    return 4 * int(np.prod(_hist_store_shape(0, ncols, b_pad, group)[1:]))
+
+
 def _hist_store_shape(num_slots, num_features, b_pad, group):
     """Per-pass histogram store shape (see _hist_accum layouts). The
     nibble layout's [12, 128] blocks fill 128-lane tiles exactly — a

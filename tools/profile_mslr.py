@@ -74,10 +74,7 @@ def main():
     if eng is not None:
         jax.block_until_ready(eng.rec[0, 0, :1])
     else:
-        sc = gb.score_updater.score if hasattr(gb, "score_updater") else None
-        import jax as j
-        j.block_until_ready(gb._train_score()) if hasattr(
-            gb, "_train_score") else None
+        np.asarray(gb.train_score.score.reshape(-1)[:1])
     dt = (time.perf_counter() - t0) / ITERS
     fb = getattr(gb, "_aligned_fallback_count", 0)
     print(f"per_iter={dt*1e3:.1f}ms fallbacks={fb}", flush=True)
