@@ -16,15 +16,22 @@ Data is synthetic at the same shapes (the 2.6 GB HIGGS csv is not
 vendored); the measured quantity — boosting-iteration throughput on a
 binned dataset plus ranking quality — is the same hot loop.
 
-Prints ONE JSON line:
+Prints the cumulative JSON summary line after EVERY stage (the last line
+is the full record; a killed run still leaves the stages that finished):
   {"metric": "higgs_synth_500iter_s", "value": <projected 500-iter s>,
    "unit": "s", "vs_baseline": <238.5 / value>, "auc": <holdout AUC>,
    "value_255bin": <projected s at max_bin=255>,
-   "ndcg10": <lambdarank NDCG@10>, "mslr_500iter_s": <projected s>}
+   "ndcg10": <lambdarank NDCG@10>, "mslr_500iter_s": <projected s>,
+   "predict_speedup": <serve engine vs seed TreePredictor>}
+
+Stages run in value order (63-bin -> 255-bin -> MSLR -> predict ->
+valid-overhead -> reference parity) and BENCH_BUDGET_S sets a wall-clock
+budget: once exceeded, remaining stages are skipped (recorded under
+"budget_skipped") instead of the whole run timing out with no output.
 
 Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
-BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_SKIP_RANK=1,
-BENCH_SKIP_255=1.
+BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
+BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1.
 """
 import json
 import os
@@ -56,9 +63,37 @@ BASELINE_S = 238.5       # docs/Experiments.rst:106 (CPU, 16 threads)
 BASELINE_MSLR_S = 215.3  # docs/Experiments.rst:110
 BASELINE_ITERS = 500
 
+_T0 = time.perf_counter()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def emit(out):
+    """Print the cumulative summary line NOW: a budget kill or crash later
+    still leaves every stage that finished on stdout."""
+    print(json.dumps(out), flush=True)
+
+
+def budget_left():
+    """Seconds until the BENCH_BUDGET_S wall budget runs out (None =
+    unbounded)."""
+    if BUDGET_S <= 0:
+        return None
+    return BUDGET_S - (time.perf_counter() - _T0)
+
+
+def budget_gate(out, stage):
+    """True when the stage still fits the budget; records the skip when
+    it doesn't."""
+    left = budget_left()
+    if left is None or left > 0:
+        return True
+    log(f"# budget exhausted ({BUDGET_S:.0f}s): skipping {stage}")
+    out.setdefault("budget_skipped", []).append(stage)
+    return False
 
 
 def synth_higgs(n: int, f: int, seed: int = 7):
@@ -148,7 +183,9 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     full_iters > 0, training CONTINUES to that many total iterations so
     the reported AUC is the true full-model quality — the number the
     full-scale reference head-to-head (tools/ref_full_headtohead.py)
-    compares against."""
+    compares against. The continue loop respects the BENCH_BUDGET_S
+    deadline: it stops at a round iteration count instead of letting the
+    whole bench get killed with nothing reported."""
     params = {
         "objective": "binary",
         "num_leaves": leaves,
@@ -175,10 +212,19 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     done = warmup + iters
     if full_iters > done:
         t0 = time.perf_counter()
-        for _ in range(full_iters - done):
-            bst.update()
-        _sync(bst)
-        log(f"#   continue to {full_iters} iters: "
+        block = 25
+        while done < full_iters:
+            left = budget_left()
+            if left is not None and left <= 0:
+                log(f"#   budget exhausted: stopping full-AUC continue at "
+                    f"{done}/{full_iters} iters")
+                break
+            step = min(block, full_iters - done)
+            for _ in range(step):
+                bst.update()
+            done += step
+            _sync(bst)
+        log(f"#   continue to {done} iters: "
             f"{time.perf_counter() - t0:.1f}s")
     auc = None
     if holdout_X is not None:
@@ -190,7 +236,7 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     log(f"# higgs mb={max_bin}: bin={t_bin:.1f}s warmup({warmup})="
         f"{t_warm:.1f}s per_iter={per_iter * 1e3:.1f}ms "
         f"aligned={'yes' if eng is not None else 'no'} fallbacks={fb}")
-    return per_iter * BASELINE_ITERS, auc
+    return per_iter * BASELINE_ITERS, auc, done
 
 
 def run_mslr(n, f, iters, warmup):
@@ -358,14 +404,15 @@ def main() -> None:
     log(f"# gen={time.perf_counter() - t0:.1f}s rows={n} features={f} "
         f"leaves={leaves}")
 
+    # ---- stage 1: 63-bin HIGGS (the headline throughput number) --------
     # full-model AUCs (500 iterations) for the reference head-to-head:
     # tools/ref_full_headtohead.py caches the reference binary's AUCs on
     # this exact data (the 1-core host makes the ref run an hours-long
     # out-of-band job); ours compute live here
     full = 0 if (smoke or os.environ.get("BENCH_SKIP_FULLAUC") == "1") \
         else BASELINE_ITERS
-    projected, auc = run_higgs(n, f, leaves, iters, warmup, 63, hX, hy,
-                               X, y, full_iters=full)
+    projected, auc, done63 = run_higgs(n, f, leaves, iters, warmup, 63,
+                                       hX, hy, X, y, full_iters=full)
     out = {
         "metric": "higgs_synth_500iter_s",
         "value": round(projected, 2),
@@ -375,14 +422,8 @@ def main() -> None:
     }
     if full:
         out["auc_ours_full_63bin"] = out["auc"]
-    if os.environ.get("BENCH_SKIP_255") != "1":
-        projected255, auc255 = run_higgs(n, f, leaves, max(iters // 2, 2),
-                                         warmup, 255, hX if full else None,
-                                         hy if full else None, X, y,
-                                         full_iters=full)
-        out["value_255bin"] = round(projected255, 2)
-        if full and auc255 is not None:
-            out["auc_ours_full_255bin"] = round(auc255, 6)
+        if done63 < full:
+            out["full_iters_done_63bin"] = done63
     ref_cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "docs", "ref_full_auc.json")
     if os.path.isfile(ref_cache):
@@ -393,20 +434,23 @@ def main() -> None:
                     out[k] = rc[k]
         except Exception:
             pass
-    if os.environ.get("BENCH_SKIP_VALID") != "1":
-        vo_iters = 3 if smoke else 10
-        per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
-                                       leaves, vo_iters, 2)
-        base_per = projected / BASELINE_ITERS
-        out["valid_overhead_pct"] = round(
-            (per_valid / base_per - 1.0) * 100.0, 1)
-    if os.environ.get("BENCH_SKIP_REF") != "1" and not smoke:
-        auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
-        if auc_ref is not None:
-            out["auc_ours_1m_100it"] = round(auc_ours_1m, 6)
-            out["auc_ref"] = round(auc_ref, 6)
-    del X, y, Xall, yall
-    if os.environ.get("BENCH_SKIP_RANK") != "1":
+    emit(out)
+
+    # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table) ----
+    if os.environ.get("BENCH_SKIP_255") != "1" and budget_gate(out, "255bin"):
+        projected255, auc255, done255 = run_higgs(
+            n, f, leaves, max(iters // 2, 2), warmup, 255,
+            hX if full else None, hy if full else None, X, y,
+            full_iters=full)
+        out["value_255bin"] = round(projected255, 2)
+        if full and auc255 is not None:
+            out["auc_ours_full_255bin"] = round(auc255, 6)
+            if done255 < full:
+                out["full_iters_done_255bin"] = done255
+        emit(out)
+
+    # ---- stage 3: MSLR lambdarank (second headline experiment) ---------
+    if os.environ.get("BENCH_SKIP_RANK") != "1" and budget_gate(out, "mslr"):
         nm = 30_000 if smoke else 2_270_000
         fm = 20 if smoke else 137
         rit = 4 if smoke else 25
@@ -414,7 +458,45 @@ def main() -> None:
         out["ndcg10"] = round(nd, 6)
         out["mslr_500iter_s"] = round(mslr_s, 2)
         out["mslr_vs_baseline"] = round(BASELINE_MSLR_S / mslr_s, 3)
-    print(json.dumps(out))
+        emit(out)
+
+    # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --
+    if os.environ.get("BENCH_SKIP_PREDICT") != "1" \
+            and budget_gate(out, "predict"):
+        try:
+            from tools.bench_predict import run as bench_predict_run
+            pred = bench_predict_run(
+                num_trees=50 if smoke else 500,
+                rows=5_000 if smoke else 100_000,
+                repeats=2 if smoke else 3)
+            for k in ("predict_seed_rows_s", "predict_engine_rows_s",
+                      "predict_speedup"):
+                out[k] = pred[k]
+        except Exception as e:   # the summary line must still print
+            log(f"# predict stage FAILED: {type(e).__name__}: {e}")
+        emit(out)
+
+    # ---- stage 5: valid-set overhead (diagnostic) ----------------------
+    if os.environ.get("BENCH_SKIP_VALID") != "1" \
+            and budget_gate(out, "valid_overhead"):
+        vo_iters = 3 if smoke else 10
+        per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
+                                       leaves, vo_iters, 2)
+        base_per = projected / BASELINE_ITERS
+        out["valid_overhead_pct"] = round(
+            (per_valid / base_per - 1.0) * 100.0, 1)
+        emit(out)
+
+    # ---- stage 6: reference-binary parity (slowest, least perishable) --
+    if os.environ.get("BENCH_SKIP_REF") != "1" and not smoke \
+            and budget_gate(out, "ref_parity"):
+        auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
+        if auc_ref is not None:
+            out["auc_ours_1m_100it"] = round(auc_ours_1m, 6)
+            out["auc_ref"] = round(auc_ref, 6)
+
+    out["wall_s"] = round(time.perf_counter() - _T0, 1)
+    emit(out)
 
 
 if __name__ == "__main__":

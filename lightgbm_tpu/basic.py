@@ -356,6 +356,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._flat_cache: Optional[tuple] = None
+        self._engine_cache: Dict[tuple, Any] = {}
         self._model_gen = 0
         self.pandas_categorical = None
         self._train_set = train_set
@@ -553,7 +554,8 @@ class Booster:
     # ------------------------------------------------------------------
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_contrib: bool = False, start_iteration: int = 0,
+                **kwargs) -> np.ndarray:
         if isinstance(data, str):
             # predict straight from a data file (reference
             # LGBM_BoosterPredictForFile, c_api.h:645-704)
@@ -624,7 +626,8 @@ class Booster:
                 for lo in range(0, csr.shape[0], rows_per):
                     outs.append(self.predict(
                         csr[lo:lo + rows_per].toarray(), num_iteration,
-                        raw_score, pred_leaf, pred_contrib, **kwargs))
+                        raw_score, pred_leaf, pred_contrib,
+                        start_iteration, **kwargs))
                 return np.concatenate(outs, axis=0)
         if (self.pandas_categorical and hasattr(data, "columns")
                 and hasattr(data, "values")):
@@ -637,52 +640,79 @@ class Booster:
         if num_iteration is None or num_iteration <= 0:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
-        trees = self.trees
-        if num_iteration and num_iteration > 0:
-            trees = trees[:num_iteration * k]
-        # flattened-forest cache for the native predictor (rebuilt when the
-        # model mutates or the tree horizon changes)
-        flat = None
-        from .native import native_available
-        if trees and native_available():
-            key = (len(trees), k, self._model_gen)
-            if self._flat_cache is not None and self._flat_cache[0] == key:
-                flat = self._flat_cache[1]
-            else:
-                flat = flatten_forest(trees, k)
-                self._flat_cache = (key, flat)
-        if pred_leaf:
-            out = _native_predict(trees, X, k, pred_leaf=True, flat=flat)
-            if out is not None:
-                return out.astype(np.int32)
-            return predict_raw_values(trees, X, leaf_index=True)
-        if pred_contrib:
-            from .ops.shap import predict_contrib
-            return predict_contrib(trees, X, k)
+        s_iter = max(int(start_iteration or 0), 0)
+        u_spec = num_iteration if num_iteration and num_iteration > 0 else -1
+        trees = self.trees[s_iter * k:]
+        if u_spec > 0:
+            trees = trees[:u_spec * k]
         n = len(X)
-        # prediction early stopping (reference prediction_early_stop.cpp):
-        # enabled via params/kwargs, classification objectives only, and
-        # the margin test fires at ITERATION boundaries (k trees each)
         opts = {**self.params, **kwargs}
         obj_name = str(opts.get("objective", self.params.get(
             "objective", ""))).split(" ")[0]
         es_ok_obj = k > 1 or obj_name == "binary"
         es_on = (bool(opts.get("pred_early_stop", False)) and not raw_score
-                 and es_ok_obj)
-        es_freq = int(opts.get("pred_early_stop_freq", 10)) * k
-        es_margin = float(opts.get("pred_early_stop_margin", 10.0))
-        raw = _native_predict(trees, X, k, flat=flat,
-                              es_freq=es_freq if es_on else 0,
-                              es_margin=es_margin)
-        if raw is None:
-            if es_on:
-                raw = _early_stop_predict_py(trees, X, k, es_freq, es_margin)
+                 and es_ok_obj and not pred_leaf and not pred_contrib)
+        from .native import native_available
+        # serving-engine policy (serve/ForestEngine): depth-synchronized
+        # device traversal with a cached, incrementally-updated stacked
+        # forest. "auto" keeps the exact native/host walk on the CPU tier
+        # unless no native library exists and the job is big enough to
+        # amortize a compile.
+        pd = str(opts.get("tpu_predict_device", "auto")).strip().lower()
+        import jax
+        use_engine = bool(trees) and not pred_contrib and not es_on and (
+            pd in ("on", "device", "true", "1")
+            or (pd == "auto"
+                and (jax.default_backend() != "cpu"
+                     or (not native_available()
+                         and n * len(trees) >= (1 << 18)))))
+        if use_engine:
+            eng = self._serve_engine(trees, s_iter, u_spec)
+            if bool(opts.get("predict_sharded", False)) and not pred_leaf:
+                raw = eng.predict_sharded(X)
             else:
-                raw = np.zeros((n, k), np.float64)
-                for cls in range(k):
-                    cls_trees = [t for i, t in enumerate(trees)
-                                 if i % k == cls]
-                    raw[:, cls] = predict_raw_values(cls_trees, X)
+                raw, leaves = eng.predict(X, pred_leaf=pred_leaf)
+                if pred_leaf:
+                    return leaves
+        else:
+            # flattened-forest cache for the native predictor (rebuilt when
+            # the model mutates or the tree horizon changes)
+            flat = None
+            if trees and native_available():
+                key = (len(trees), k, s_iter, self._model_gen)
+                if self._flat_cache is not None \
+                        and self._flat_cache[0] == key:
+                    flat = self._flat_cache[1]
+                else:
+                    flat = flatten_forest(trees, k)
+                    self._flat_cache = (key, flat)
+            if pred_leaf:
+                out = _native_predict(trees, X, k, pred_leaf=True, flat=flat)
+                if out is not None:
+                    return out.astype(np.int32)
+                return predict_raw_values(trees, X, leaf_index=True)
+            if pred_contrib:
+                from .ops.shap import predict_contrib
+                return predict_contrib(trees, X, k)
+            # prediction early stopping (reference
+            # prediction_early_stop.cpp): enabled via params/kwargs,
+            # classification objectives only, and the margin test fires at
+            # ITERATION boundaries (k trees each)
+            es_freq = int(opts.get("pred_early_stop_freq", 10)) * k
+            es_margin = float(opts.get("pred_early_stop_margin", 10.0))
+            raw = _native_predict(trees, X, k, flat=flat,
+                                  es_freq=es_freq if es_on else 0,
+                                  es_margin=es_margin)
+            if raw is None:
+                if es_on:
+                    raw = _early_stop_predict_py(trees, X, k, es_freq,
+                                                 es_margin)
+                else:
+                    raw = np.zeros((n, k), np.float64)
+                    for cls in range(k):
+                        cls_trees = [t for i, t in enumerate(trees)
+                                     if i % k == cls]
+                        raw[:, cls] = predict_raw_values(cls_trees, X)
         if self._is_average_output():
             raw = raw / max(1, len(trees) // k)
         objective = self._objective_for_predict()
@@ -695,6 +725,23 @@ class Booster:
         else:
             conv = raw
         return conv[:, 0] if k == 1 else conv
+
+    def _serve_engine(self, trees, s_iter: int, u_spec: int):
+        """Cached serve/ForestEngine per (start, horizon) slice. The
+        engine checks its tree-id prefix on reuse, so trees appended by
+        `update()` stack incrementally instead of re-uploading the whole
+        forest; any other model mutation restacks from scratch."""
+        key = (s_iter, u_spec)
+        eng = self._engine_cache.get(key)
+        if eng is None:
+            from .serve import ForestEngine
+            eng = ForestEngine(trees, num_class=self.num_tree_per_iteration)
+            if len(self._engine_cache) >= 8:
+                self._engine_cache.pop(next(iter(self._engine_cache)))
+            self._engine_cache[key] = eng
+        else:
+            eng.update(trees)
+        return eng
 
     def _is_average_output(self) -> bool:
         if self._loaded is not None:

@@ -144,16 +144,22 @@ class Application:
             raise LightGBMError("No model file: set input_model=<file>")
         if not cfg.data:
             raise LightGBMError("No prediction data: set data=<file>")
-        booster = Booster(model_file=cfg.input_model)
-        loader = DatasetLoader(cfg)
-        _, feats, _ex = loader.parse_file(cfg.data)
+        booster = Booster(params=dict(self.raw_params),
+                          model_file=cfg.input_model)
         num_iteration = cfg.num_iteration_predict
+        # hand the PATH to Booster.predict: its file branch carries the
+        # reference's label-free detection (a file whose column count
+        # equals the model's feature count has no label column to strip,
+        # predictor.hpp:185) which a direct DatasetLoader.parse_file
+        # call would skip, silently shifting every feature by one
         preds = booster.predict(
-            feats,
+            cfg.data,
             num_iteration=(num_iteration if num_iteration > 0 else None),
             raw_score=cfg.predict_raw_score,
             pred_leaf=cfg.predict_leaf_index,
-            pred_contrib=cfg.predict_contrib)
+            pred_contrib=cfg.predict_contrib,
+            start_iteration=cfg.start_iteration_predict,
+            tpu_predict_device=cfg.tpu_predict_device)
         out = cfg.output_result or "LightGBM_predict_result.txt"
         arr = np.atleast_1d(np.asarray(preds))
         from .io.file_io import open_file

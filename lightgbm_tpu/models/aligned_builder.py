@@ -190,7 +190,11 @@ class AlignedEngine:
             self.compact = bool(
                 objective.point_grad_fn() is not None
                 and weight is None and lab01
-                and learner.n <= (1 << 24))  # rid must fit 24 meta bits
+                and learner.n <= (1 << 24)   # rid must fit 24 meta bits
+                # tpu_force_big_n exercises the big-n physical layout
+                # (exact i32 count pass + 9-bit route repack) at small n,
+                # which the compact layout would otherwise shadow
+                and not bool(getattr(self.cfg, "tpu_force_big_n", False)))
         with_prob = self.mc_mode == "prob"
         # external-gradient objectives (ranking) drop the label/weight
         # lanes: g/h arrive in row order with weights folded in
@@ -459,7 +463,8 @@ class AlignedEngine:
         # counts stay histogram-driven: only leaves larger than 2^24
         # rows see sub-ppm count fuzz there, far from any min_data
         # guard; documented divergence)
-        big_n = self.n > (1 << 24)
+        big_n = (self.n > (1 << 24)
+                 or bool(getattr(self.cfg, "tpu_force_big_n", False)))
 
         def _gsum(x):
             return lax.psum(x, axis) if dp else x

@@ -1061,67 +1061,83 @@ class DeviceTreeLearner:
         run: TPU pallas (or interpret mode for tests), a pointwise
         single-class objective, serial parallelism; numerical AND
         categorical features, with or without bagging (round 4)."""
+        return self.aligned_mode_gate(objective) is None
+
+    def aligned_mode_gate(self, objective):
+        """First failing aligned-pipeline gate as a short name, or None
+        when every gate passes. The gate rationale (VERDICT r5 #8: path
+        observability) lives with each check; `aligned_mode_ok` is the
+        boolean view."""
         mode = self.cfg.tpu_grow_mode
         if mode not in ("auto", "aligned"):
-            return False
+            return f"tpu_grow_mode={mode}"
         if self.cfg.sequential_device_only:
             # forced splits / CEGB need the sequential fused loop
-            return False
+            return "sequential-only features (forced splits/CEGB)"
         from ..ops.aligned import aligned_available
         if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
-            return False
+            return "pallas kernels unavailable (no TPU, interpret off)"
         from ..ops.aligned import aligned_num_chunks
         from .level_builder import spec_slots
         S = spec_slots(self.cfg.num_leaves,
                        float(getattr(self.cfg, "tpu_level_spec", 1.5)))
         nc = aligned_num_chunks(self.n, self.cfg, S,
                                 self.num_features)
-        return (self.parallel_mode in ("serial", "data")
-                # multiclass deferred-application machinery (and its
-                # fallback) stays serial-only for now
-                and (self.parallel_mode == "serial"
-                     or (objective is not None
-                         and objective.num_model_per_iteration == 1))
-                # EFB bundles ride natively (round 5): records pack the
-                # <= 256-bin bundle columns, routing unpacks in-kernel,
-                # per-feature histograms expand at eval only
-                # packed-prefetch limits: 16-bit destination chunk ids
-                # (NC <= 65535 at the EFFECTIVE chunk size, ~67M rows at
-                # C=1024) and 8-bit word selectors (features <= 1020).
-                # Above 2^24 rows the physical layout switches to the
-                # exact i32 count pass (see aligned_builder big_n)
-                and nc <= 65535
-                and self.num_features <= 1020
-                and self.ds.bins is not None
-                and self.ds.bins.dtype == np.uint8
-                and self.num_features > 0
-                and self.cfg.num_leaves >= 2
-                and self.max_bin_global <= 256
-                and self.hist_bins <= 256
-                and objective is not None
-                and (objective.num_model_per_iteration == 1
-                     # multiclass rides K score lanes + lane-wise
-                     # in-program gradients (compact layout only: the
-                     # meta-lane rid keeps the 2^24-row cap there)
-                     or (objective.num_model_per_iteration <= 127
-                         and objective.mc_lane_mode() is not None
-                         and self.n <= (1 << 24)))
-                # non-pointwise objectives pay a row-order gradient
-                # round-trip (materialize + gather); the ext record
-                # layout (round 5) plus the [K]-compact hist/eval path
-                # made this a win at the MSLR shape (2.27M x 137 at 63
-                # bins: 562 vs the fused 1264 ms/iter) — but only while
-                # the per-slot histogram block is small enough for a
-                # workable K (wide-F x 256-bin nibble blocks force K=64
-                # AND still blow VMEM: MSLR at 255 bins measured 2.06 s
-                # vs fused 1.26). Gate: a row floor where the
-                # round-trip amortizes plus the slot-block budget;
-                # forced tpu_grow_mode=aligned bypasses both.
-                and (objective.point_grad_fn() is not None
-                     or objective.num_model_per_iteration > 1
-                     or (self.n >= 1_000_000
-                         and self._aligned_slot_bytes() <= (512 << 10))
-                     or mode == "aligned"))
+        if self.parallel_mode not in ("serial", "data"):
+            return f"parallel_mode={self.parallel_mode}"
+        # multiclass deferred-application machinery (and its fallback)
+        # stays serial-only for now
+        if not (self.parallel_mode == "serial"
+                or (objective is not None
+                    and objective.num_model_per_iteration == 1)):
+            return "multiclass under data-parallel"
+        # EFB bundles ride natively (round 5): records pack the <= 256-bin
+        # bundle columns, routing unpacks in-kernel, per-feature
+        # histograms expand at eval only. packed-prefetch limits: 16-bit
+        # destination chunk ids (NC <= 65535 at the EFFECTIVE chunk size,
+        # ~67M rows at C=1024) and 8-bit word selectors (features <=
+        # 1020). Above 2^24 rows the physical layout switches to the
+        # exact i32 count pass (see aligned_builder big_n)
+        if nc > 65535:
+            return f"chunk count {nc} > 65535"
+        if self.num_features > 1020:
+            return f"num_features {self.num_features} > 1020"
+        if self.ds.bins is None or self.ds.bins.dtype != np.uint8:
+            return "bins not uint8"
+        if self.num_features <= 0:
+            return "no features"
+        if self.cfg.num_leaves < 2:
+            return "num_leaves < 2"
+        if self.max_bin_global > 256 or self.hist_bins > 256:
+            return "max_bin > 256"
+        if objective is None:
+            return "no objective"
+        if objective.num_model_per_iteration != 1:
+            # multiclass rides K score lanes + lane-wise in-program
+            # gradients (compact layout only: the meta-lane rid keeps the
+            # 2^24-row cap there)
+            if objective.num_model_per_iteration > 127:
+                return "num_class > 127"
+            if objective.mc_lane_mode() is None:
+                return "objective lacks a multiclass lane mode"
+            if self.n > (1 << 24):
+                return "multiclass above 2^24 rows"
+        # non-pointwise objectives pay a row-order gradient round-trip
+        # (materialize + gather); the ext record layout (round 5) plus the
+        # [K]-compact hist/eval path made this a win at the MSLR shape
+        # (2.27M x 137 at 63 bins: 562 vs the fused 1264 ms/iter) — but
+        # only while the per-slot histogram block is small enough for a
+        # workable K (wide-F x 256-bin nibble blocks force K=64 AND still
+        # blow VMEM: MSLR at 255 bins measured 2.06 s vs fused 1.26).
+        # Gate: a row floor where the round-trip amortizes plus the
+        # slot-block budget; forced tpu_grow_mode=aligned bypasses both.
+        if not (objective.point_grad_fn() is not None
+                or objective.num_model_per_iteration > 1
+                or (self.n >= 1_000_000
+                    and self._aligned_slot_bytes() <= (512 << 10))
+                or mode == "aligned"):
+            return "non-pointwise objective below the row floor"
+        return None
 
     def _aligned_slot_bytes(self) -> int:
         """Bytes of ONE slot's histogram block in the aligned engine's

@@ -48,6 +48,17 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     HAS_PALLAS = True
+    # jax renamed TPUCompilerParams -> CompilerParams (and grew fields
+    # like has_side_effects along the way); HBM was addressed as the ANY
+    # memory space before it got its own name. Accept either vintage.
+    _CP_CLS = getattr(pltpu, "CompilerParams",
+                      getattr(pltpu, "TPUCompilerParams", None))
+    _HBM = getattr(pltpu, "HBM", getattr(pltpu, "ANY", None))
+
+    def _CompilerParams(**kw):
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(_CP_CLS)}
+        return _CP_CLS(**{k: v for k, v in kw.items() if k in known})
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
@@ -769,10 +780,10 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
         in_specs=[
             pl.BlockSpec((1, w_pad, chunk),
                          lambda i, a, b, c, d, e, f, g: (g[i], 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.HBM),   # DMA src for copies
+            pl.BlockSpec(memory_space=_HBM),   # DMA src for copies
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
             # constant index map: the compact hist store is resident in
             # VMEM for the whole pass and written back once at the end
             pl.BlockSpec(store_shape,
@@ -794,7 +805,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
             jax.ShapeDtypeStruct(records.shape, jnp.int32),
             jax.ShapeDtypeStruct(store_shape, jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 << 20, has_side_effects=True),
         interpret=interpret,
     )(r1p, r2, blbr, meta, hslots, cbits, fetch_idx, records, records)
@@ -878,7 +889,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots + 1,), jnp.int32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
         interpret=interpret,
     )(r1, r2, meta, wsel, kslots, cbits, records)
     return out[:num_slots]
@@ -958,7 +969,7 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(store_shape, jnp.float32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
         interpret=interpret,
     )(slots, meta, records)
     return _hist_store_finalize(out, num_slots, num_features, b_pad,

@@ -34,6 +34,15 @@ try:  # pallas is TPU-only here; import lazily-guarded for CPU test runs
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     HAS_PALLAS = True
+    # jax renamed TPUCompilerParams -> CompilerParams (and grew fields
+    # like has_side_effects along the way). Accept either vintage.
+    _CP_CLS = getattr(pltpu, "CompilerParams",
+                      getattr(pltpu, "TPUCompilerParams", None))
+
+    def _CompilerParams(**kw):
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(_CP_CLS)}
+        return _CP_CLS(**{k: v for k, v in kw.items() if k in known})
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
@@ -114,7 +123,7 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
         ],
         out_specs=pl.BlockSpec((f, b_pad, w), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((f, b_pad, w), jnp.float32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
     )(bins_rows, pay)
     # fold the lo-parts back into the hi sums; drop the bin padding
     return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
@@ -182,7 +191,7 @@ def pallas_histogram_words(words, g: jax.Array, h: jax.Array,
                                lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((num_features, b_pad, 6),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
     )(*words2, pay)
     return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
 

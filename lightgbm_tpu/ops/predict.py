@@ -61,7 +61,16 @@ def stack_trees(trees: List[Tree], binned: bool) -> Dict[str, np.ndarray]:
             rc[i, :n] = t.right_child[:n]
             dbin[i, :n] = t.node_default_bin[:n]
             nbin[i, :n] = t.node_num_bin[:n]
-            max_depth = max(max_depth, t.max_depth)
+            # exact depth from the child arrays (Tree.leaf_depth is only
+            # populated by some builders; a static traversal bound must
+            # never undershoot)
+            stack = [(0, 1)]
+            while stack:
+                node, d = stack.pop()
+                max_depth = max(max_depth, d)
+                for c in (t.left_child[node], t.right_child[node]):
+                    if c >= 0:
+                        stack.append((int(c), d + 1))
         leaf_val[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
         word_tree_start[i] = len(words)
         bounds = t.cat_boundaries_inner if binned else t.cat_boundaries
@@ -87,8 +96,68 @@ def stack_trees(trees: List[Tree], binned: bool) -> Dict[str, np.ndarray]:
 
 @jax.jit
 def _predict_binned_stacked(bins, stk, bundle=None):
-    """Traverse all trees over the binned matrix; returns [T, N] leaf
-    indices. `bundle` = (col, boff, bpk) per-feature arrays under EFB."""
+    """Depth-synchronized traversal of all trees over the binned matrix:
+    a [T, N] node frontier advances one level per step for every tree at
+    once (vs the seed per-tree `lax.scan` kept below as
+    `_predict_binned_stacked_serial`). Returns [T, N] leaf indices.
+    `bundle` = (col, boff, bpk) per-feature arrays under EFB."""
+    n = bins.shape[0]
+    dt = stk["decision_type"]
+    thr_bin = stk["threshold_in_bin"]
+    sf = stk["split_feature"]
+    dbin = stk["default_bin"]
+    nbin = stk["num_bin"]
+    cstart = stk["cat_start"]
+    clen = stk["cat_len"]
+    cwords = stk["cat_words"]
+    lc = stk["left_child"]
+    rc = stk["right_child"]
+    t_count = lc.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    def take(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    def body(node):
+        safe = jnp.maximum(node, 0)                       # [T, N]
+        feat = take(sf, safe)
+        scol = feat if bundle is None else bundle[0][feat]
+        fval = bins[rows, scol].astype(jnp.int32)
+        d = take(dt, safe).astype(jnp.int32)
+        default_left = (d & 2) != 0
+        mt = (d >> 2) & 3
+        tb = take(thr_bin, safe)
+        db = take(dbin, safe)
+        nb = take(nbin, safe)
+        if bundle is not None:
+            from .partition import bundle_unpack
+            fval = bundle_unpack(fval, bundle[1][feat], bundle[2][feat],
+                                 db, nb)
+        is_default = jnp.where(mt == MISSING_ZERO_C, fval == db,
+                               jnp.where(mt == MISSING_NAN_C,
+                                         fval == nb - 1, False))
+        num_left = jnp.where(is_default, default_left, fval <= tb)
+        widx = jnp.clip(take(cstart, safe) + (fval >> 5), 0,
+                        cwords.shape[0] - 1)
+        cat_left = ((((cwords[widx] >> (fval & 31).astype(jnp.uint32))
+                      & 1) != 0)
+                    & ((fval >> 5) < take(clen, safe)))
+        go_left = jnp.where((d & 1) != 0, cat_left, num_left)
+        nxt = jnp.where(go_left, take(lc, safe), take(rc, safe))
+        return jnp.where(node >= 0, nxt, node)
+
+    node0 = jnp.where(stk["num_leaves"][:, None] <= 1,
+                      jnp.full((t_count, n), -1, jnp.int32),
+                      jnp.zeros((t_count, n), jnp.int32))
+    node = lax.while_loop(lambda s: jnp.any(s >= 0), body, node0)
+    return ~node  # [T, N]
+
+
+@jax.jit
+def _predict_binned_stacked_serial(bins, stk, bundle=None):
+    """The seed traversal — one tree at a time (`lax.scan` + per-tree
+    `while_loop`). Kept as the baseline `tools/bench_predict.py` measures
+    the depth-synchronized paths against."""
     n = bins.shape[0]
     dt = stk["decision_type"]
     thr_bin = stk["threshold_in_bin"]
@@ -152,15 +221,22 @@ def _predict_binned_stacked(bins, stk, bundle=None):
 
 
 class TreePredictor:
-    """Batched prediction over a list of trees."""
+    """Batched prediction over a list of trees. The stacked forest is
+    built (and uploaded) at most once per (instance, binned) pair — the
+    serving path's cross-call cache is `serve.ForestEngine`."""
 
     def __init__(self, trees: List[Tree]) -> None:
         self.trees = trees
+        self._stk_cache: Dict[bool, Dict[str, jax.Array]] = {}
 
     def _stacked(self, binned: bool):
-        stk = stack_trees(self.trees, binned)
-        return {k: jnp.asarray(v) for k, v in stk.items()
-                if isinstance(v, np.ndarray)}
+        stk = self._stk_cache.get(binned)
+        if stk is None:
+            host = stack_trees(self.trees, binned)
+            stk = {k: jnp.asarray(v) for k, v in host.items()
+                   if isinstance(v, np.ndarray)}
+            self._stk_cache[binned] = stk
+        return stk
 
     def predict_binned_leaves(self, bins, bundle=None) -> jax.Array:
         """[T, N] leaf indices over binned data. `bundle` = (col, boff,
@@ -172,8 +248,7 @@ class TreePredictor:
         """[T, N] -> summed leaf values [N] (f64 on host for exactness is the
         caller's choice; device f32 here)."""
         leaves = self.predict_binned_leaves(bins)
-        stk = stack_trees(self.trees, binned=True)
-        lv = jnp.asarray(stk["leaf_value"], jnp.float32)
+        lv = self._stacked(binned=True)["leaf_value"].astype(jnp.float32)
         vals = jnp.take_along_axis(lv, leaves, axis=1)
         return vals.sum(axis=0)
 

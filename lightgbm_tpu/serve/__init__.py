@@ -1,0 +1,3 @@
+"""Batch inference engine: device-resident stacked forests, depth-
+synchronized traversal, shape-bucketed jit cache (ROADMAP serving path)."""
+from .engine import ForestEngine, stack_forest  # noqa: F401

@@ -294,14 +294,17 @@ class LGBMModel(_SKBase):
         return self
 
     def predict(self, X, raw_score=False, num_iteration=None,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, start_iteration=0,
+                **kwargs):
         X = self._sk_validate_predict(X)   # raises NotFittedError
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted")
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib)
+                                     pred_contrib=pred_contrib,
+                                     start_iteration=start_iteration,
+                                     **kwargs)
 
     # properties ---------------------------------------------------------
     @property
@@ -375,21 +378,27 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         return self
 
     def predict(self, X, raw_score=False, num_iteration=None,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, start_iteration=0,
+                **kwargs):
         result = self.predict_proba(X, raw_score=raw_score,
                                     num_iteration=num_iteration,
                                     pred_leaf=pred_leaf,
-                                    pred_contrib=pred_contrib)
+                                    pred_contrib=pred_contrib,
+                                    start_iteration=start_iteration,
+                                    **kwargs)
         if raw_score or pred_leaf or pred_contrib:
             return result
         return self._classes[np.argmax(result, axis=1)]
 
     def predict_proba(self, X, raw_score=False, num_iteration=None,
-                      pred_leaf=False, pred_contrib=False, **kwargs):
+                      pred_leaf=False, pred_contrib=False,
+                      start_iteration=0, **kwargs):
         result = super().predict(X, raw_score=raw_score,
                                  num_iteration=num_iteration,
                                  pred_leaf=pred_leaf,
-                                 pred_contrib=pred_contrib)
+                                 pred_contrib=pred_contrib,
+                                 start_iteration=start_iteration,
+                                 **kwargs)
         if raw_score or pred_leaf or pred_contrib:
             return result
         if self._n_classes <= 2 and result.ndim == 1:

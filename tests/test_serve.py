@@ -1,0 +1,160 @@
+"""Serving-engine tests: `serve.ForestEngine` vs the host f64 walk.
+
+The engine's contract: leaf routing bit-exact vs `predict_raw_values`
+(the reference Predictor semantics, predictor.hpp:66-115) across
+categorical splits, every missing mode, EFB-trained models, and
+multiclass; one compiled program per shape bucket (no retrace across
+batch sizes inside a bucket); and incremental device-cache invalidation
+when training appends trees.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.predict import predict_raw_values
+from lightgbm_tpu.serve import ForestEngine
+
+
+def _train(n=600, f=8, seed=0, cat_cols=(), num_class=1, params_extra=None,
+           zero_missing=False, iters=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    for c in cat_cols:
+        X[:, c] = rng.integers(0, 8, n)
+    if zero_missing:
+        X[rng.random((n, f)) < 0.15] = 0.0
+    if num_class > 1:
+        y = rng.integers(0, num_class, n).astype(float)
+        params = {"objective": "multiclass", "num_class": num_class}
+    else:
+        y = (rng.random(n) < 0.5).astype(float)
+        params = {"objective": "binary"}
+    params.update({"verbose": -1, "num_leaves": 12, "min_data_in_leaf": 10})
+    if zero_missing:
+        params["zero_as_missing"] = True
+    if params_extra:
+        params.update(params_extra)
+    ds = lgb.Dataset(X, label=y, categorical_feature=list(cat_cols))
+    bst = lgb.train(params, ds, num_boost_round=iters,
+                    keep_training_booster=True)
+    return bst, X, y
+
+
+def _engine_margin(bst, X):
+    eng = ForestEngine(bst.trees, num_class=bst.num_tree_per_iteration,
+                       mode="raw")
+    return eng, eng.predict(X)[0]
+
+
+def _host_margin(bst, X):
+    k = bst.num_tree_per_iteration
+    out = np.zeros((len(X), k))
+    for c in range(k):
+        out[:, c] = predict_raw_values(bst.trees[c::k], X)
+    return out
+
+
+@pytest.mark.parametrize("case", ["plain", "nan", "zero_missing", "cat",
+                                  "efb", "multiclass"])
+def test_engine_parity_vs_host_walk(case):
+    kw = {}
+    if case == "zero_missing":
+        kw["zero_missing"] = True
+    elif case == "cat":
+        kw["cat_cols"] = (0, 3)
+    elif case == "efb":
+        # sparse complementary columns so EFB actually bundles
+        kw["params_extra"] = {"enable_bundle": True}
+    elif case == "multiclass":
+        kw["num_class"] = 3
+    bst, X, y = _train(**kw)
+    if case == "efb":
+        rng = np.random.default_rng(3)
+        mask = rng.integers(0, 4, X.shape) > 0
+        X = np.where(mask, 0.0, X)
+    Xq = X[:257].copy()
+    if case == "nan":
+        rng = np.random.default_rng(4)
+        Xq[rng.random(Xq.shape) < 0.2] = np.nan
+    if case == "cat":
+        # unseen, negative, and NaN categories route right / by missing type
+        Xq[:5, 0] = [50.0, -3.0, np.nan, 7.9, 0.0]
+    eng, got = _engine_margin(bst, Xq)
+    want = _host_margin(bst, Xq)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+    # leaf routing is bit-exact, not just numerically close
+    leaves = eng.predict(Xq, pred_leaf=True)[1]
+    want_leaves = predict_raw_values(bst.trees, Xq, leaf_index=True)
+    np.testing.assert_array_equal(leaves, want_leaves)
+
+
+def test_no_retrace_across_batch_sizes():
+    bst, X, _ = _train()
+    eng = ForestEngine(bst.trees, mode="raw")
+    rng = np.random.default_rng(1)
+    eng.predict(rng.normal(size=(400, X.shape[1])))   # warm the 512 bucket
+    warm = eng.compile_count
+    assert warm == 1
+    for n in (300, 511, 257, 385):                    # all bucket to 512
+        eng.predict(rng.normal(size=(n, X.shape[1])))
+    assert eng.compile_count == warm, \
+        "batch sizes inside one bucket must not retrace"
+    eng.predict(rng.normal(size=(600, X.shape[1])))   # 1024 bucket
+    assert eng.compile_count == warm + 1
+
+
+def test_cache_invalidation_on_append():
+    bst, X, y = _train(iters=4)
+    eng = ForestEngine(bst.trees, num_class=1, mode="raw")
+    before = eng.predict(X[:100])[0]
+    np.testing.assert_allclose(before[:, 0], predict_raw_values(bst.trees,
+                                                                X[:100]),
+                               rtol=2e-5, atol=2e-6)
+    n_old = eng.num_trees
+    bst.update()                     # training appends a tree
+    eng2 = eng.update(bst.trees)
+    assert eng2 is eng, "append must reuse the engine, not rebuild it"
+    assert eng.num_trees == n_old + 1
+    after = eng.predict(X[:100])[0]
+    np.testing.assert_allclose(after[:, 0],
+                               predict_raw_values(bst.trees, X[:100]),
+                               rtol=2e-5, atol=2e-6)
+    assert np.any(after != before)
+
+
+def test_booster_predict_engine_path():
+    bst, X, _ = _train()
+    on = bst.predict(X[:200], raw_score=True, tpu_predict_device="on")
+    off = bst.predict(X[:200], raw_score=True, tpu_predict_device="off")
+    np.testing.assert_allclose(on, off, rtol=2e-5, atol=2e-6)
+    # start_iteration / num_iteration slice identically on both paths
+    a = bst.predict(X[:200], raw_score=True, start_iteration=2,
+                    num_iteration=2, tpu_predict_device="on")
+    b = bst.predict(X[:200], raw_score=True, start_iteration=2,
+                    num_iteration=2, tpu_predict_device="off")
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    pl_on = bst.predict(X[:64], pred_leaf=True, tpu_predict_device="on")
+    pl_off = bst.predict(X[:64], pred_leaf=True, tpu_predict_device="off")
+    np.testing.assert_array_equal(pl_on, pl_off)
+
+
+def test_binned_engine_matches_tree_predictor():
+    bst, X, _ = _train()
+    gb = bst._gbdt
+    bins = np.asarray(gb.train_data.bins)
+    if getattr(gb.train_data, "bundles", None):
+        pytest.skip("binned engine scores unbundled matrices only")
+    from lightgbm_tpu.ops.predict import TreePredictor
+    eng = ForestEngine(bst.trees, mode="binned")
+    got = eng.predict(bins)[0][:, 0]
+    want = np.asarray(TreePredictor(bst.trees).predict_binned_score(bins))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_predict_matches_single_device():
+    import jax
+    bst, X, _ = _train()
+    eng = ForestEngine(bst.trees, mode="raw")
+    single = eng.predict(X)[0]
+    sharded = eng.predict_sharded(X, devices=jax.devices())
+    np.testing.assert_allclose(sharded, single, rtol=2e-5, atol=2e-6)

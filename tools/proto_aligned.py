@@ -36,6 +36,16 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_HBM = getattr(pltpu, "HBM", getattr(pltpu, "ANY", None))
+_CP_CLS = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+
+
+def _CompilerParams(**kw):
+    import dataclasses
+    known = {f.name for f in dataclasses.fields(_CP_CLS)}
+    return _CP_CLS(**{k: v for k, v in kw.items() if k in known})
+
 W = 16          # record lanes (i32)
 NWORDS = 7      # packed bin words for F=28
 LG, LH = NWORDS, NWORDS + 1   # g/h record lanes
@@ -119,7 +129,7 @@ def slot_hist(records, slots, cnts, num_slots, num_features, b_pad,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots, ngroups, 6, group * b_pad),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
     )(slots, zeros, cnts, records)
     out = out.reshape(num_slots, ngroups, 6, group, b_pad)
     out = out[:, :, :3] + out[:, :, 3:]
@@ -279,7 +289,7 @@ def move(records, params, chunk, nc_out=None):
         grid=(nc,),
         in_specs=[pl.BlockSpec((1, W, chunk),
                                lambda i, r, bl, br, m: (i, 0, 0))],
-        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        out_specs=pl.BlockSpec(memory_space=_HBM),
         scratch_shapes=[
             pltpu.VMEM((W, 4 * chunk), jnp.int32),
             pltpu.SMEM((8,), jnp.int32),
@@ -290,7 +300,7 @@ def move(records, params, chunk, nc_out=None):
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nc_out, W, chunk), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 << 20, has_side_effects=True),
     )(route, basel, baser, meta, records)
 

@@ -17,6 +17,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_CP_CLS = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+
+
+def _CompilerParams(**kw):
+    import dataclasses
+    known = {f.name for f in dataclasses.fields(_CP_CLS)}
+    return _CP_CLS(**{k: v for k, v in kw.items() if k in known})
+
 C = 512
 W = 16
 N_CHUNKS = 20000
@@ -137,7 +146,7 @@ def bench(kernel, rec):
         out_shape=jax.ShapeDtypeStruct((1, W, C), jnp.int32),
         scratch_shapes=[pltpu.VMEM((W, 4 * C), jnp.int32),
                         pltpu.SMEM((8,), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
     )
     fj = jax.jit(lambda r: f(r))
     out = fj(rec)
