@@ -29,9 +29,19 @@ valid-overhead -> reference parity) and BENCH_BUDGET_S sets a wall-clock
 budget: once exceeded, remaining stages are skipped (recorded under
 "budget_skipped") instead of the whole run timing out with no output.
 
+Compile-cost accounting (first-class JSON fields): "warmup_s" /
+"warmup_s_255bin" (wall seconds of the warmup iterations, compile
+included), "compile_s" / "compile_s_255bin" (warmup minus steady-state
+iteration cost), "compile_cache_hit" (persistent cache had entries
+before this process compiled), "compile_cache" {dir, entries_before,
+entries_after}, and "warmup_s_warm" + "warm_speedup" from a
+fresh-process rerun of the 63-bin warmup leg (stage 1b).
+
 Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
-BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1.
+BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
+BENCH_SKIP_WARM=1. LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR
+override the persistent-cache location (default: ./.jax_cache).
 """
 import json
 import os
@@ -43,21 +53,21 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# persistent XLA compilation cache: repeat bench runs (and real users'
-# repeat processes) skip the multi-minute warmup compiles
-import jax  # noqa: E402
-
+# Persistent XLA compilation cache: repeat bench runs (and real users'
+# repeat processes) skip the multi-minute warmup compiles. Routed through
+# lightgbm_tpu's own tpu_compile_cache_dir wiring rather than raw
+# jax.config: the direct wiring that used to live here kept jax's default
+# 2 s min-compile-time floor, which silently skipped every sub-2 s
+# round-loop program — the cache never hit. config.Config.update() calls
+# compile_cache.init_persistent_cache() with the floor dropped to 0 and
+# the XLA-client caches enabled, before the first trace.
 _cache = os.environ.get(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-try:
-    os.makedirs(_cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-except Exception:
-    pass
+os.environ.setdefault("LGBT_COMPILE_CACHE_DIR", _cache)
 
 import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import compile_cache  # noqa: E402
 
 BASELINE_S = 238.5       # docs/Experiments.rst:106 (CPU, 16 threads)
 BASELINE_MSLR_S = 215.3  # docs/Experiments.rst:110
@@ -208,7 +218,7 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     for _ in range(iters):
         bst.update()
     _sync(bst)
-    per_iter = (time.perf_counter() - t0) / iters
+    per_iter = (time.perf_counter() - t0) / max(iters, 1)
     done = warmup + iters
     if full_iters > done:
         t0 = time.perf_counter()
@@ -236,7 +246,15 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     log(f"# higgs mb={max_bin}: bin={t_bin:.1f}s warmup({warmup})="
         f"{t_warm:.1f}s per_iter={per_iter * 1e3:.1f}ms "
         f"aligned={'yes' if eng is not None else 'no'} fallbacks={fb}")
-    return per_iter * BASELINE_ITERS, auc, done
+    stats = {
+        "bin_s": round(t_bin, 2),
+        "warmup_s": round(t_warm, 2),
+        # warmup time minus the steady-state cost of those iterations —
+        # i.e. the trace + XLA-compile (or cache-load) bill of the stage
+        "compile_s": round(max(t_warm - warmup * per_iter, 0.0), 2),
+        "per_iter_ms": round(per_iter * 1e3, 2),
+    }
+    return per_iter * BASELINE_ITERS, auc, done, stats
 
 
 def run_mslr(n, f, iters, warmup):
@@ -388,7 +406,50 @@ def run_ref_parity(X, y, hX, hy, leaves):
     return auc_ours, auc_ref
 
 
+def warm_rerun_child() -> None:
+    """BENCH_WARMRERUN_CHILD=1 mode: a fresh process repeating ONLY the
+    63-bin bin+warmup leg on identical data, so the parent can certify
+    the persistent compile cache (warm warmup_s vs its own cold one).
+    Emits a single JSON line."""
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n = int(os.environ.get("BENCH_ROWS", 20_000 if smoke else 10_500_000))
+    f = int(os.environ.get("BENCH_FEATURES", 28))
+    warmup = int(os.environ.get("BENCH_WARMUP", 2 if smoke else 5))
+    leaves = int(os.environ.get("BENCH_LEAVES", 31 if smoke else 255))
+    X, y = synth_higgs(n, f)
+    _, _, _, stats = run_higgs(n, f, leaves, 0, warmup, 63,
+                               None, None, X, y)
+    emit({"warmup_s": stats["warmup_s"], "bin_s": stats["bin_s"],
+          "cache_entries": compile_cache.cache_dir_entries(
+              compile_cache.persistent_cache_dir())})
+
+
+def run_warm_rerun(out):
+    """Spawn the fresh-process warm rerun and record cold vs warm."""
+    import subprocess
+    env = dict(os.environ)
+    env["BENCH_WARMRERUN_CHILD"] = "1"
+    try:
+        t0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=3600)
+        child = json.loads(res.stdout.strip().splitlines()[-1])
+        out["warmup_s_warm"] = child["warmup_s"]
+        cold = out.get("warmup_s")
+        if cold:
+            out["warm_speedup"] = round(cold / max(child["warmup_s"],
+                                                   1e-9), 2)
+        log(f"# warm rerun (fresh process): warmup_s={child['warmup_s']}"
+            f" vs cold={cold} ({time.perf_counter() - t0:.1f}s total)")
+    except Exception as e:   # the summary line must still print
+        log(f"# warm rerun FAILED: {type(e).__name__}: {e}")
+
+
 def main() -> None:
+    if os.environ.get("BENCH_WARMRERUN_CHILD") == "1":
+        warm_rerun_child()
+        return
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_ROWS", 20_000 if smoke else 10_500_000))
     f = int(os.environ.get("BENCH_FEATURES", 28))
@@ -396,6 +457,8 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", 2 if smoke else 5))
     leaves = int(os.environ.get("BENCH_LEAVES", 31 if smoke else 255))
     n_hold = 4_000 if smoke else 500_000
+    entries_before = compile_cache.cache_dir_entries(
+        os.environ.get("LGBT_COMPILE_CACHE_DIR"))
 
     t0 = time.perf_counter()
     Xall, yall = synth_higgs(n + n_hold, f)
@@ -411,14 +474,28 @@ def main() -> None:
     # out-of-band job); ours compute live here
     full = 0 if (smoke or os.environ.get("BENCH_SKIP_FULLAUC") == "1") \
         else BASELINE_ITERS
-    projected, auc, done63 = run_higgs(n, f, leaves, iters, warmup, 63,
-                                       hX, hy, X, y, full_iters=full)
+    projected, auc, done63, stats63 = run_higgs(n, f, leaves, iters, warmup,
+                                                63, hX, hy, X, y,
+                                                full_iters=full)
+    cache_dir = compile_cache.persistent_cache_dir()
+    entries_after = compile_cache.cache_dir_entries(cache_dir)
     out = {
         "metric": "higgs_synth_500iter_s",
         "value": round(projected, 2),
         "unit": "s",
         "vs_baseline": round(BASELINE_S / projected, 3),
         "auc": round(auc, 6) if auc is not None else None,
+        "warmup_s": stats63["warmup_s"],
+        "compile_s": stats63["compile_s"],
+        "bin_s": stats63["bin_s"],
+        # warm start = the persistent cache already held programs when
+        # this process compiled its first one
+        "compile_cache_hit": entries_before > 0,
+        "compile_cache": {
+            "dir": cache_dir,
+            "entries_before": entries_before,
+            "entries_after": entries_after,
+        },
     }
     if full:
         out["auc_ours_full_63bin"] = out["auc"]
@@ -436,13 +513,22 @@ def main() -> None:
             pass
     emit(out)
 
+    # ---- stage 1b: fresh-process warm rerun (certifies the persistent
+    # cache: the child re-pays binning but should load, not compile) ----
+    if os.environ.get("BENCH_SKIP_WARM") != "1" \
+            and budget_gate(out, "warm_rerun"):
+        run_warm_rerun(out)
+        emit(out)
+
     # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table) ----
     if os.environ.get("BENCH_SKIP_255") != "1" and budget_gate(out, "255bin"):
-        projected255, auc255, done255 = run_higgs(
+        projected255, auc255, done255, stats255 = run_higgs(
             n, f, leaves, max(iters // 2, 2), warmup, 255,
             hX if full else None, hy if full else None, X, y,
             full_iters=full)
         out["value_255bin"] = round(projected255, 2)
+        out["warmup_s_255bin"] = stats255["warmup_s"]
+        out["compile_s_255bin"] = stats255["compile_s"]
         if full and auc255 is not None:
             out["auc_ours_full_255bin"] = round(auc255, 6)
             if done255 < full:

@@ -1,0 +1,202 @@
+"""Process-wide XLA program registry and persistent-compile-cache wiring.
+
+Training used to build its jitted programs per ``Booster`` instance: every
+``DeviceTreeLearner`` / ``AlignedEngine`` held its own dict of
+``jax.jit`` wrappers, so a second model trained on the same shapes paid
+the full trace + XLA-compile bill again.  jax's trace cache is keyed on
+the *function object*, and a fresh closure per instance is a fresh
+function object — a cache that can never hit across instances.
+
+This module fixes that at two levels:
+
+* ``program(key, factory)`` — a process-wide registry of jitted
+  programs.  ``key`` must capture everything the factory closure bakes
+  into the trace (shapes, static ints, config scalars, and fingerprints
+  of any *data* arrays the closure captures).  Two engines with equal
+  keys share one jitted callable and therefore one trace per input
+  shape.
+* ``init_persistent_cache(path)`` — one-shot wiring of jax's on-disk
+  compilation cache so a fresh *process* also skips XLA compilation.
+  Exposed to users via the ``tpu_compile_cache_dir`` parameter
+  (see ``config.py``); ``bench.py`` goes through the same entry point.
+
+``note_trace()`` / ``trace_count()`` implement the compile-count
+regression contract: every registered program body bumps the counter
+when its Python source actually runs (i.e. once per jax trace), so a
+test can train twice at the same shape and assert the second run
+performed zero traces.  This mirrors ``serve.ForestEngine.compile_count``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+_programs: Dict[Any, Callable] = {}
+_trace_count = 0
+
+
+def note_trace() -> None:
+    """Record one jax trace. Call at the top of every registered program
+    body — the Python body runs once per trace, never on cache hits."""
+    global _trace_count
+    _trace_count += 1
+
+
+def trace_count() -> int:
+    return _trace_count
+
+
+def program(key: Any, factory: Callable[[], Callable]) -> Callable:
+    """Return the process-wide jitted program for ``key``, building it
+    via ``factory()`` on first use. ``key`` must be hashable and must
+    cover every value the factory's closure bakes into the trace."""
+    fn = _programs.get(key)
+    if fn is None:
+        with _lock:
+            fn = _programs.get(key)
+            if fn is None:
+                fn = factory()
+                _programs[key] = fn
+    return fn
+
+
+def registry_size() -> int:
+    return len(_programs)
+
+
+def clear_programs() -> None:
+    """Drop every registered program (tests only — releases the device
+    buffers captured by program closures)."""
+    with _lock:
+        _programs.clear()
+
+
+def array_fingerprint(*arrays) -> str:
+    """Stable content hash of host/device arrays, for registry keys.
+
+    Program closures legitimately capture data-derived device arrays
+    (bin meta tables, objective label/weight buffers). Sharing such a
+    program between models is only sound when that captured data is
+    identical, so the registry key carries a digest of it.
+    """
+    h = hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        if a is None:
+            h.update(b"\x00none")
+            continue
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def config_signature(cfg) -> Tuple:
+    """Hashable snapshot of every Config field (program closures read
+    hyperparameters freely, so the whole config is part of the key)."""
+    import dataclasses
+
+    items = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, (list, tuple)):
+            v = tuple(v) if all(
+                isinstance(x, (int, float, str, bool, type(None)))
+                for x in v) else repr(v)
+        elif not isinstance(v, (int, float, str, bool, type(None))):
+            v = repr(v)
+        items.append((f.name, v))
+    return tuple(items)
+
+
+class HashableFn:
+    """Wrap a callable so it hashes/compares by an explicit signature.
+
+    ``move_pass`` / ``slot_hist_pass`` take the point-gradient callback
+    as a *static* jit argument; jax keys the trace cache on its hash.
+    Objectives hand out a fresh closure per instance, so without this
+    wrapper every new Booster forced a retrace of the module-level
+    kernels even though the closures compute the same function.
+    """
+
+    __slots__ = ("fn", "sig")
+
+    def __init__(self, fn: Callable, sig: Any):
+        self.fn = fn
+        self.sig = ("HashableFn", sig)
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __hash__(self):
+        return hash(self.sig)
+
+    def __eq__(self, other):
+        return isinstance(other, HashableFn) and self.sig == other.sig
+
+    def __repr__(self):  # keeps jax debug names stable across instances
+        return f"HashableFn({self.sig!r})"
+
+
+_persistent_cache_dir: Optional[str] = None
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _persistent_cache_dir
+
+
+def cache_dir_entries(path: Optional[str]) -> int:
+    """Count cache files currently in a compilation-cache directory."""
+    if not path or not os.path.isdir(path):
+        return 0
+    n = 0
+    for _root, _dirs, files in os.walk(path):
+        n += len(files)
+    return n
+
+
+def init_persistent_cache(path: str) -> str:
+    """Point jax's persistent compilation cache at ``path`` (one-shot).
+
+    The earlier bench-only wiring missed for two reasons: it kept the
+    default ``min_compile_time_secs`` floor of 2 s (the round loop is
+    dozens of sub-2 s programs — none were written), and on non-TPU
+    backends jax additionally requires the XLA-client caches to be
+    opted in before anything persists. Both are forced here, and the
+    setup runs before the first trace because ``Config.update`` calls
+    it when ``tpu_compile_cache_dir`` is parsed.
+
+    Idempotent: the first directory wins for the process lifetime
+    (jax's cache config cannot be swapped once populated).
+    """
+    global _persistent_cache_dir
+    if _persistent_cache_dir is not None:
+        return _persistent_cache_dir
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    for opt, val in (
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+        # Required for cache hits on the CPU backend; harmless on TPU.
+        ("jax_persistent_cache_enable_xla_caches", "all"),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass  # older jax: option absent, dir + floor still apply
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.set_cache_dir(path)
+    except Exception:
+        pass
+    _persistent_cache_dir = path
+    return path

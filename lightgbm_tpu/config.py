@@ -13,6 +13,7 @@ pallas toggle, mesh axes) — the analogue of the reference's `gpu_*` block
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -398,6 +399,18 @@ class Config:
     # pass + 9-bit route repack, normally n > 2^24 only) at any row count
     # so the path is testable on small data (VERDICT r5 #7)
     tpu_force_big_n: bool = False
+    # directory for jax's persistent XLA compilation cache (or via the
+    # LGBT_COMPILE_CACHE_DIR environment variable). Wired BEFORE any
+    # program traces, with the min-compile-time floor dropped to 0 s
+    # (jax's default 2 s floor silently skips every sub-2 s round-loop
+    # program) and the XLA-client caches enabled on non-TPU backends: a
+    # fresh process loads compiled executables from disk instead of
+    # recompiling, cutting warmup by the full XLA-compile bill. One-shot
+    # per process — the first directory wins. In-process, training
+    # programs are additionally deduplicated by a registry keyed on
+    # shape/config/data fingerprints (compile_cache.py), so a second
+    # Booster at the same shapes performs zero new traces either way
+    tpu_compile_cache_dir: str = ""
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False
@@ -457,6 +470,14 @@ class Config:
                 setattr(self, name, str(value))
         self._normalize()
         self._check_conflicts()
+        cache_dir = self.tpu_compile_cache_dir or os.environ.get(
+            "LGBT_COMPILE_CACHE_DIR", "")
+        if cache_dir:
+            # Wire jax's persistent compilation cache before any trace
+            # happens (Config.update always precedes Dataset/Booster
+            # construction). One-shot per process; see compile_cache.py.
+            from . import compile_cache
+            compile_cache.init_persistent_cache(cache_dir)
         return self
 
     # ------------------------------------------------------------------

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import compile_cache
 from ..ops.aligned import (META_BAG, META_LABEL, META_LABEL_MASK,
                            META_RID_MASK, R_CAT,
                            R_COPY, R_DL, R_MT, R_SHIFT, _bpw_for_bits,
@@ -287,7 +288,27 @@ class AlignedEngine:
             self.rec = jnp.asarray(rec_all)
             self.cnts = jnp.asarray(cnts_all)
         self._pgrad = objective.point_grad_fn()
+        if self._pgrad is not None:
+            # hash/eq by signature: the point-grad closure rides into
+            # move_pass/slot_hist_pass as a STATIC jit arg, and a fresh
+            # closure per engine would retrace the module-level kernels
+            self._pgrad = compile_cache.HashableFn(
+                self._pgrad, ("pgrad", objective.trace_signature()))
         self._programs = {}
+        # process-wide program identity: everything the engine's program
+        # factories bake into their traces (the learner signature covers
+        # config + bin metadata + mesh; the objective signature covers
+        # gradient closures incl. content-hashed label/weight data)
+        import os as _os
+        self._trace_sig = (
+            "aligned", learner.trace_signature(),
+            objective.trace_signature(), self.C, self.NC, self.S,
+            self.W, self.wcnt, self.w_used, self.bits,
+            tuple(sorted(self.lanes.items())), self.compact, self.ext,
+            self.gh_off, self.num_class, self.mc_mode, self.interpret,
+            self.bagged, self.axis, self.nd, self.per_shard,
+            _os.environ.get("LGBT_KCAP", ""),
+            str(self.mesh) if self.mesh is not None else None)
         self._score_cache = None     # (iter_tag, np array)
         self._iter_tag = 0
         # exactness of the LAST dispatched program (device scalar): the
@@ -450,7 +471,12 @@ class AlignedEngine:
         # single-class compact: pointwise gradients inline in the
         # kernels; multiclass: per-class closure over prob/score lanes
         if multiclass:
-            gfn = self._mc_payload_fn(class_k)
+            # signature-hashed so the static grad_fn arg of the kernel
+            # jits compares equal across engine instances
+            gfn = compile_cache.HashableFn(
+                self._mc_payload_fn(class_k),
+                ("mc_payload", self.objective.trace_signature(), class_k,
+                 self.mc_mode, self.bagged))
         else:
             gfn = self._pgrad if self.compact else None
         score_lane = ln["score"] + class_k
@@ -1082,16 +1108,31 @@ class AlignedEngine:
         """jit (and, data-parallel, shard_map) a program factory. specs =
         (in_specs, out_specs) pytrees of PartitionSpec for the DP case;
         programs whose inputs are all replicated pass specs=None and run
-        unwrapped (XLA replicates them across the mesh)."""
+        unwrapped (XLA replicates them across the mesh).
+
+        Programs live in the process-wide registry keyed by the engine's
+        trace signature, so a second engine at the same shape/config/data
+        reuses the jitted callable — zero new traces. Every program body
+        bumps compile_cache.note_trace() exactly once per jax trace."""
         fn = self._programs.get(key)
         if fn is None:
-            inner = factory()
-            if self.axis is not None and specs is not None:
-                inner = jax.shard_map(inner, mesh=self.mesh,
-                                      in_specs=specs[0],
-                                      out_specs=specs[1],
-                                      check_vma=False)
-            fn = jax.jit(inner, donate_argnums=donate)
+            def build_jit():
+                inner = factory()
+
+                def traced(*args, **kwargs):
+                    compile_cache.note_trace()
+                    return inner(*args, **kwargs)
+
+                wrapped = traced
+                if self.axis is not None and specs is not None:
+                    wrapped = jax.shard_map(wrapped, mesh=self.mesh,
+                                            in_specs=specs[0],
+                                            out_specs=specs[1],
+                                            check_vma=False)
+                return jax.jit(wrapped, donate_argnums=donate)
+
+            fn = compile_cache.program(
+                self._trace_sig + ("prog", key), build_jit)
             self._programs[key] = fn
         return fn
 
@@ -1139,20 +1180,21 @@ class AlignedEngine:
             fn = self._program(
                 "build_ext",
                 lambda: self._build_program(external_grads=True),
-                donate=(0,), specs=self._specs("build_ext")
+                donate=(0, 1), specs=self._specs("build_ext")
                 if self.axis else None)
             rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact, grads[0], grads[1])
         else:
-            fn = self._program("build", self._build_program, donate=(0,),
-                               specs=self._specs("build")
+            fn = self._program("build", self._build_program,
+                               donate=(0, 1), specs=self._specs("build")
                                if self.axis else None)
             rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
                 self.rec, self.cnts, fmask, jnp.float32(scale),
                 self._last_exact)
         self._last_exact = exact_dev
-        # the records were donated: the physical layout advances either
+        # records AND per-chunk counts were donated (in-place round
+        # loop): the physical layout advances either
         # way (harmless — the next root re-reads everything); the SCORE
         # lane was updated on device only when the replay was exact.
         # NOTHING is pulled here: the caller checks `exact_dev` one
@@ -1184,7 +1226,7 @@ class AlignedEngine:
         fmask = self.learner._fmask_arr(feature_mask)
         fn = self._program(
             ("build_mc", class_k),
-            lambda: self._build_program(class_k=class_k), donate=(0,))
+            lambda: self._build_program(class_k=class_k), donate=(0, 1))
         if self._mc_pending is None:
             pleafI, pcover, pn_exec, pscale = self._null_prev()
         else:
@@ -1263,20 +1305,22 @@ class AlignedEngine:
             return jnp.stack(outs)
         return fn
 
-    def apply_spec_to_scores(self, score, vbins, spec, applied, scale):
-        """score [Nv] += scale * committed_tree(vbins) ON DEVICE — the
-        valid-set analogue of the score-lane update (gbdt.cpp:487-506),
-        walking the committed-exec chains of the spec. Gated by `applied`
-        (the exact & prev_ok flag): a dispatch the host will discard
-        contributes exactly 0, so this can be dispatched pipelined with
-        no sync."""
-        key = ("walk", vbins.shape)
-        fn = self._programs.get(key)
-        if fn is None:
-            fn = jax.jit(self._walk_program(), donate_argnums=(0,))
-            self._programs[key] = fn
-        return fn(score, vbins, spec.execI, spec.execB, spec.first_c,
-                  spec.nxt_c, spec.cover, jnp.float32(scale), applied)
+    def apply_spec_to_scores(self, score, lane, vbins, spec, applied,
+                             scale):
+        """score [K, Nv] lane `lane` += scale * committed_tree(vbins) ON
+        DEVICE — the valid-set analogue of the score-lane update
+        (gbdt.cpp:487-506), walking the committed-exec chains of the
+        spec. Gated by `applied` (the exact & prev_ok flag): a dispatch
+        the host will discard contributes exactly 0, so this can be
+        dispatched pipelined with no sync. The FULL [K, Nv] buffer is
+        donated and updated in place at a device-side lane index — the
+        old per-lane form (`score[k]` gather in, `.at[k].set` scatter
+        out) cost two full-buffer copies per valid set per round."""
+        fn = self._program(("walk", vbins.shape), self._walk_program,
+                           donate=(0,))
+        return fn(score, jnp.int32(lane), vbins, spec.execI, spec.execB,
+                  spec.first_c, spec.nxt_c, spec.cover,
+                  jnp.float32(scale), applied)
 
     def _walk_program(self):
         lr = self.learner
@@ -1291,8 +1335,8 @@ class AlignedEngine:
             boff = lr._boff_dev
             bpk = lr._bpk_dev
 
-        def fn(score, vb, execI, execB, first_c, nxt_c, cover, scale,
-               applied):
+        def fn(score, lane, vb, execI, execB, first_c, nxt_c, cover,
+               scale, applied):
             nv = vb.shape[0]
             node0 = jnp.full(nv, first_c[0], jnp.int32)
             slot0 = jnp.zeros(nv, jnp.int32)
@@ -1334,7 +1378,9 @@ class AlignedEngine:
 
             node, slot = lax.while_loop(cond, body, (node0, slot0))
             gate = applied.astype(jnp.float32)
-            return score + cover[jnp.clip(slot, 0, S)] * scale * gate
+            # in-place lane update on the donated [K, Nv] buffer
+            return score.at[lane].add(
+                cover[jnp.clip(slot, 0, S)] * scale * gate)
         return fn
 
     def undo_spec_scores(self, spec, applied, scale):

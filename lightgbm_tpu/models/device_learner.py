@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import compile_cache
 from ..config import Config
 from ..io.dataset import Dataset
 from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered_gh
@@ -279,6 +280,47 @@ class DeviceTreeLearner:
             self._boff_dev = jnp.zeros(self.num_features, jnp.int32)
             self._bpk_dev = jnp.zeros(self.num_features, jnp.int32)
 
+    def trace_signature(self) -> Tuple:
+        """Hashable key covering everything this learner's build-program
+        closures bake into a jax trace: the full config, the binning
+        metadata (content-hashed — closures capture the device copies as
+        constants), bundling tables, data shape, and mesh placement.
+        Programs built by learners with equal signatures are shared
+        process-wide (see compile_cache.program), so a second Booster on
+        the same shapes triggers zero new traces."""
+        sig = getattr(self, "_trace_sig_cache", None)
+        if sig is None:
+            m = self.meta
+            bundle_fp = None
+            if self.bundled:
+                bnd = self.ds.bundles
+                bundle_fp = compile_cache.array_fingerprint(
+                    bnd.col, bnd.off, bnd.packed, bnd.group_num_bin)
+            forced = (tuple(map(tuple, self._forced_nodes()))
+                      if self.cfg.forcedsplits_filename else ())
+            sig = ("learner", type(self).__name__,
+                   compile_cache.config_signature(self.cfg),
+                   compile_cache.array_fingerprint(
+                       m["num_bin"], m["default_bin"], m["missing_type"],
+                       m["bin_type"], m["monotone"], m["penalty"]),
+                   bundle_fp, self.n, self.num_features,
+                   self.num_real_features, self.max_bin_global,
+                   self.hist_bins, self.axis_name, self.parallel_mode,
+                   self.mesh_size, self.min_pad, self.hist_precision,
+                   forced)
+            self._trace_sig_cache = sig
+        return sig
+
+    def _cached_program(self, key, factory):
+        """Two-level program lookup: per-instance memo over the
+        process-wide registry (keyed by trace_signature + key)."""
+        fn = self._build_cache.get(key)
+        if fn is None:
+            fn = compile_cache.program(
+                self.trace_signature() + ("prog", key), factory)
+            self._build_cache[key] = fn
+        return fn
+
     @property
     def bins_dev(self) -> jax.Array:
         if self._bins_dev is None:
@@ -315,12 +357,10 @@ class DeviceTreeLearner:
         return self._words_dev
 
     def _level_fn(self):
-        fn = self._build_cache.get("level")
-        if fn is None:
+        def factory():
             from .level_builder import make_level_build_fn
-            fn = make_level_build_fn(self)
-            self._build_cache["level"] = fn
-        return fn
+            return make_level_build_fn(self)
+        return self._cached_program("level", factory)
 
     def _level_train_fresh(self, grad, hess, feature_mask):
         """Speculative level build + host leaf-wise replay; falls back to
@@ -652,6 +692,7 @@ class DeviceTreeLearner:
 
         def _build(bins, bins_T, indices, gh, root_count, feature_mask_f32,
                    coupled_eff=None):
+            compile_cache.note_trace()
             if cegb_coupled_on:
                 coupled_box[0] = coupled_eff
 
@@ -1252,11 +1293,9 @@ class DeviceTreeLearner:
         (new partition indices, TreeRecord). `indices` must be padded so
         begin+bucket_size never overflows (length n + pow2ceil(n))."""
         root_padded = max(_pow2ceil(root_count), self.min_pad)
-        key = (root_padded, False)
-        fn = self._build_cache.get(key)
-        if fn is None:
-            fn = self._make_build_fn(root_padded, False)
-            self._build_cache[key] = fn
+        fn = self._cached_program(
+            (root_padded, False),
+            lambda: self._make_build_fn(root_padded, False))
         args = [self.bins_dev, self.bins_T_dev, indices, grad, hess,
                 jnp.int32(root_count), self._fmask_arr(feature_mask)]
         if self._cegb_coupled_on:
@@ -1276,11 +1315,9 @@ class DeviceTreeLearner:
             if out is not None:
                 return out
         root_padded = max(_pow2ceil(self.n), self.min_pad)
-        key = (root_padded, True)
-        fn = self._build_cache.get(key)
-        if fn is None:
-            fn = self._make_build_fn(root_padded, True)
-            self._build_cache[key] = fn
+        fn = self._cached_program(
+            (root_padded, True),
+            lambda: self._make_build_fn(root_padded, True))
         args = [self.bins_dev, self.bins_T_dev, grad, hess,
                 self._fmask_arr(feature_mask)]
         if self._cegb_coupled_on:
@@ -1307,28 +1344,37 @@ class DeviceTreeLearner:
             if out is not None:
                 return out
         root_padded = max(_pow2ceil(self.n), self.min_pad)
-        key = (root_padded, "iter_fused", id(objective))
-        fn = self._build_cache.get(key)
-        if fn is None:
-            build = self._make_build_fn(root_padded, True)
+        # the fused step closes over the objective's gradient program,
+        # which captures label/weight device data — the objective's
+        # trace signature (content-hashed data) keys the shared program
+        key = (root_padded, "iter_fused", objective.trace_signature())
 
-            def step(score, scale, fmask, coupled_eff=None):
+        def factory():
+            build = self._make_build_fn(root_padded, True)
+            n_rows = self.n
+            cegb_on = self._cegb_coupled_on
+
+            def step(score, bins, bins_T, scale, fmask, coupled_eff=None):
+                # bins ride as runtime args (not closure constants) so
+                # the program is data-independent and registry-shareable
+                compile_cache.note_trace()
                 gdev, hdev = objective.gradients_impl(score)
                 # nested jitted calls inline into this trace
-                bargs = [self.bins_dev, self.bins_T_dev, gdev[0],
-                         hdev[0], fmask]
-                if self._cegb_coupled_on:
+                bargs = [bins, bins_T, gdev[0], hdev[0], fmask]
+                if cegb_on:
                     bargs.append(coupled_eff)
                 indices, rec = build(*bargs)
                 new_score = _partition_score_update(
                     score, jnp.int32(0), rec.leaf_begin,
                     rec.leaf_cnt_part, rec.leaf_value, indices,
-                    jnp.int32(self.n), scale)
+                    jnp.int32(n_rows), scale)
                 return new_score, indices, rec
 
-            fn = jax.jit(step, donate_argnums=(0,))
-            self._build_cache[key] = fn
-        args = [score, jnp.float32(scale), self._fmask_arr(feature_mask)]
+            return jax.jit(step, donate_argnums=(0,))
+
+        fn = self._cached_program(key, factory)
+        args = [score, self.bins_dev, self.bins_T_dev, jnp.float32(scale),
+                self._fmask_arr(feature_mask)]
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
         out = fn(*args)
@@ -1342,18 +1388,20 @@ class DeviceTreeLearner:
         block score update. Returns None when the replay was inexact (the
         caller then runs the sequential leaf-wise fused path)."""
         from .level_builder import replay_leafwise
-        key = ("level_iterA", id(objective))
-        fnA = self._build_cache.get(key)
-        if fnA is None:
+        key = ("level_iterA", objective.trace_signature())
+
+        def factory():
             level = self._level_fn()
 
-            def stepA(score, fmask):
+            def stepA(score, words, fmask):
+                compile_cache.note_trace()
                 gdev, hdev = objective.gradients_impl(score)
-                return level(self.words_dev, gdev[0], hdev[0], fmask)
+                return level(words, gdev[0], hdev[0], fmask)
 
-            fnA = jax.jit(stepA)
-            self._build_cache[key] = fnA
-        spec = fnA(score, self._fmask_arr(feature_mask))
+            return jax.jit(stepA)
+
+        fnA = self._cached_program(key, factory)
+        spec = fnA(score, self.words_dev, self._fmask_arr(feature_mask))
         host = jax.device_get(spec._replace(rid=None))
         rec, exact = replay_leafwise(host, self.cfg.num_leaves)
         if not exact:
@@ -1416,6 +1464,7 @@ def _partition_score_update(score, class_id, leaf_begin, leaf_cnt,
                             leaf_value, indices, count, scale):
     """One fused program: leaf fill over the partition + key-sort back to
     row order + score[class_id] += scale * delta."""
+    compile_cache.note_trace()
     n = score.shape[1]
     # leaf slices all live inside [0, n): fill and sort only that prefix
     fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value, n)
@@ -1425,6 +1474,7 @@ def _partition_score_update(score, class_id, leaf_begin, leaf_cnt,
 
 @functools.partial(jax.jit, static_argnames=("padded",))
 def _masked_sums(indices, gh, count, padded: int):
+    compile_cache.note_trace()
     idx = lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
     pos = jnp.arange(padded, dtype=jnp.int32)
     valid = pos < count
@@ -1440,6 +1490,7 @@ def _masked_sums(indices, gh, count, padded: int):
 def traversal_arrays(rec: TreeRecord, max_nodes: int):
     """Build device traversal arrays (feature/threshold/children) from a
     TreeRecord — the on-device analogue of `stack_trees`."""
+    compile_cache.note_trace()
     left, right = record_to_children(rec.leaf, rec.num_splits, max_nodes)
     return {
         "feature": rec.feature, "threshold_bin": rec.threshold_bin,
@@ -1455,6 +1506,7 @@ def traverse_record(bins: jax.Array, trav: Dict, nb, db, mt,
     """[N] leaf index per row for one TreeRecord's tree over binned data.
     nb/db/mt: per-feature num_bin/default_bin/missing arrays; col/boff/bpk
     map features to bundled storage columns (EFB, io/bundling.py)."""
+    compile_cache.note_trace()
     n = bins.shape[0]
 
     def cond(node):
@@ -1492,5 +1544,6 @@ def add_record_score(score_row: jax.Array, bins: jax.Array, trav: Dict,
                      nb, db, mt, scale, col=None, boff=None,
                      bpk=None) -> jax.Array:
     """score += scale * tree(x) for all rows via record traversal."""
+    compile_cache.note_trace()
     leaves = traverse_record(bins, trav, nb, db, mt, col, boff, bpk)
     return score_row + scale * trav["leaf_value"][leaves]

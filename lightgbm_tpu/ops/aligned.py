@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import compile_cache
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -755,6 +757,7 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
     covered by the new layout keep stale rows; hist slots never present
     in hslots are zero.
     """
+    compile_cache.note_trace()
     nc = records.shape[0]
     dummy = num_slots
     store_shape = _hist_store_shape(num_slots, num_features, b_pad, group)
@@ -871,6 +874,7 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
     kslots[i] = compact id of chunk i's selected split (num_slots =
     skip); r1/r2/meta/wsel as for move_pass (copy bit must be CLEAR for
     counted chunks)."""
+    compile_cache.note_trace()
     nc = records.shape[0]
     w_pad = records.shape[1]
     kernel = functools.partial(_count_kernel, chunk=chunk,
@@ -947,6 +951,7 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
     across the grid (constant out-spec) and zeroed once, so unvisited
     slots read as zero and chunk order is unconstrained.
     """
+    compile_cache.note_trace()
     nc = records.shape[0]
     dummy = num_slots
     store_shape = _hist_store_shape(num_slots, num_features, b_pad, group)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import compile_cache
 from ..config import Config
 from ..io.dataset import Metadata
 
@@ -58,14 +59,44 @@ class ObjectiveFunction:
             self._weight_np = np.asarray(metadata.weight, np.float32)
             self.weight = jnp.asarray(self._weight_np)
 
+    def trace_signature(self) -> Tuple:
+        """Hashable key covering everything this objective's gradient
+        closures bake into a jax trace: the concrete class, its scalar
+        parameters, and fingerprints of the label/weight/query data the
+        closures capture as device constants. Two objectives with equal
+        signatures may share one compiled gradient program."""
+        sig = self.__dict__.get("_trace_sig")
+        if sig is None:
+            scalars = tuple(
+                (k, v) for k, v in sorted(self.__dict__.items())
+                if isinstance(v, (int, float, bool, str)))
+            sig = ("obj", type(self).__name__, self.num_class,
+                   self.weight is not None, scalars,
+                   compile_cache.array_fingerprint(
+                       self._label_np, self._weight_np,
+                       getattr(self, "query_boundaries", None)))
+            self.__dict__["_trace_sig"] = sig
+        return sig
+
     # grad/hess: [K, N] given scores [K, N]. The public entry jits the
-    # per-class `gradients_impl` once per objective instance so the whole
-    # gradient computation is ONE device program, not a chain of eager ops
-    # (each eager dispatch costs a host round-trip on a tunneled TPU).
+    # per-class `gradients_impl` once so the whole gradient computation
+    # is ONE device program, not a chain of eager ops (each eager
+    # dispatch costs a host round-trip on a tunneled TPU). The jitted
+    # program lives in the process-wide registry keyed by the
+    # objective's trace signature, so a second model over the same data
+    # reuses it instead of retracing.
     def get_gradients(self, scores: jax.Array) -> Tuple[jax.Array, jax.Array]:
         fn = self.__dict__.get("_jit_gradients")
         if fn is None:
-            fn = jax.jit(self.gradients_impl)
+            impl = self.gradients_impl
+
+            def traced(scores):
+                compile_cache.note_trace()
+                return impl(scores)
+
+            fn = compile_cache.program(
+                ("gradients", self.trace_signature()),
+                lambda: jax.jit(traced))
             self.__dict__["_jit_gradients"] = fn
         return fn(scores)
 
@@ -701,6 +732,7 @@ class LambdarankNDCG(ObjectiveFunction):
         @jax.jit
         def per_bucket(scores_q, labels_q, mask_q, inv_q):
             # scores_q [Q, S]; labels_q int32; mask_q bool; inv_q [Q]
+            compile_cache.note_trace()
             neg_inf = jnp.float32(-np.inf)
             s = jnp.where(mask_q, scores_q, neg_inf)
             order = jnp.argsort(-s, axis=1, stable=True)   # desc, pads last
@@ -779,7 +811,13 @@ class LambdarankNDCG(ObjectiveFunction):
                 self._bucket_dev_tables().items():
             fn = self._grad_fns.get(size)
             if fn is None:
-                fn = self._make_grad_fn(size)
+                # per-bucket programs capture only cfg-derived constants
+                # (sigmoid, label_gain, discounts) — bucket data arrives
+                # as runtime args — so they dedup across models by size.
+                fn = compile_cache.program(
+                    ("rank_bucket", size, float(self.cfg.sigmoid),
+                     tuple(float(g) for g in self.label_gain)),
+                    lambda: self._make_grad_fn(size))
                 self._grad_fns[size] = fn
             sc = score[didx] * mask  # [Q, S]
             gq, hq = fn(sc, labels_q, mask, inv)

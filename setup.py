@@ -1,10 +1,17 @@
-"""Build hook: stage the native C++ sources inside the package and
-pre-build the helper .so when a toolchain is available (reference
+"""Build hook: stage the native C++ sources into the BUILD OUTPUT tree
+and pre-build the helper .so when a toolchain is available (reference
 python-package/setup.py compiles lib_lightgbm at install time; here the
 library is optional — lightgbm_tpu/native.py also builds it lazily and
-falls back to pure Python with a warning)."""
+falls back to pure Python with a warning).
+
+Staging goes to ``<build_lib>/lightgbm_tpu/_native_src`` — NOT the
+in-tree package directory. The earlier hook copied into
+``lightgbm_tpu/_native_src/`` inside the checkout, leaving untracked
+build products in the working tree after every ``pip install .``; the
+installed package gets the same layout either way (native.py falls back
+to ``_native_src`` next to the module when ``src/native`` is absent).
+"""
 import os
-import shutil
 import subprocess
 
 from setuptools import setup
@@ -12,28 +19,27 @@ from setuptools.command.build_py import build_py
 
 ROOT = os.path.dirname(os.path.abspath(__file__))
 NATIVE_SRC = os.path.join(ROOT, "src", "native")
-PKG_NATIVE = os.path.join(ROOT, "lightgbm_tpu", "_native_src")
-
-
-def _stage_native() -> None:
-    if not os.path.isdir(NATIVE_SRC):
-        return
-    os.makedirs(PKG_NATIVE, exist_ok=True)
-    for name in os.listdir(NATIVE_SRC):
-        if name.endswith((".cpp", ".h")) or name == "Makefile":
-            shutil.copy2(os.path.join(NATIVE_SRC, name),
-                         os.path.join(PKG_NATIVE, name))
-    try:  # best-effort pre-build; import-time make is the fallback
-        subprocess.run(["make", "-C", PKG_NATIVE], check=False,
-                       capture_output=True, timeout=300)
-    except Exception:
-        pass
 
 
 class BuildPyWithNative(build_py):
     def run(self):
-        _stage_native()
         super().run()
+        self._stage_native()
+
+    def _stage_native(self) -> None:
+        if not os.path.isdir(NATIVE_SRC):
+            return
+        dest = os.path.join(self.build_lib, "lightgbm_tpu", "_native_src")
+        os.makedirs(dest, exist_ok=True)
+        for name in os.listdir(NATIVE_SRC):
+            if name.endswith((".cpp", ".h")) or name == "Makefile":
+                self.copy_file(os.path.join(NATIVE_SRC, name),
+                               os.path.join(dest, name))
+        try:  # best-effort pre-build; import-time make is the fallback
+            subprocess.run(["make", "-C", dest], check=False,
+                           capture_output=True, timeout=300)
+        except Exception:
+            pass
 
 
 setup(cmdclass={"build_py": BuildPyWithNative})

@@ -39,3 +39,27 @@ def test_force_big_n_matches_default_layout():
     np.testing.assert_allclose(on.predict(X[:128], raw_score=True),
                                off.predict(X[:128], raw_score=True),
                                rtol=1e-6, atol=1e-9)
+
+
+def test_force_big_n_leaf_counts_exact_i32():
+    """The big-n count pass must deliver EXACT integer leaf populations
+    (the f32 histogram-sum shortcut loses integer exactness past 2^24
+    rows — the whole reason the i32 count pass exists). Certify by
+    routing every training row through the finished trees host-side and
+    demanding integer equality with the recorded per-leaf counts."""
+    rng = np.random.default_rng(19)
+    n = 900
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((X[:, 0] - X[:, 1] * X[:, 2]
+          + 0.3 * rng.standard_normal(n)) > 0).astype(np.float32)
+    bst = _train(X, y, True, iters=3)
+    leaf_idx = bst.predict(X, pred_leaf=True).astype(np.int64)
+    if leaf_idx.ndim == 1:
+        leaf_idx = leaf_idx[:, None]
+    assert leaf_idx.shape[1] == len(bst.trees)
+    for t, tree in enumerate(bst.trees):
+        counts = np.bincount(leaf_idx[:, t], minlength=tree.num_leaves)
+        recorded = tree.leaf_count[:tree.num_leaves]
+        assert recorded.dtype == np.int32
+        assert int(recorded.sum()) == n
+        np.testing.assert_array_equal(recorded, counts)

@@ -1,0 +1,71 @@
+"""Trace-once training contract: the process-wide program registry
+(`compile_cache.program`) must make a second Booster at identical
+shapes/config reuse every jitted training program — zero new jax traces.
+
+Every registered program body bumps `compile_cache.note_trace()` when its
+Python source runs (once per trace, never on a trace-cache hit), so the
+counter is a direct compile-count probe: train one model, snapshot the
+counter, train a second identically-shaped model, assert the counter did
+not move. Mirrors `serve.ForestEngine.compile_count` in test_serve.py.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import compile_cache
+
+ALIGNED = {"tpu_grow_mode": "aligned", "tpu_aligned_interpret": True,
+           "tpu_chunk": 256}
+
+
+def _data(seed=3, n=900, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]
+          + 0.3 * rng.standard_normal(n)) > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, extra=None, iters=3):
+    params = {"objective": "binary", "num_leaves": 8, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none"}
+    if extra:
+        params.update(extra)
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+def _assert_trace_once(extra):
+    X, y = _data()
+    b1 = _train(X, y, extra)
+    p1 = b1.predict(X[:128], raw_score=True)
+    before = compile_cache.trace_count()
+    assert before > 0, "no registered program traced at all"
+    b2 = _train(X, y, extra)
+    p2 = b2.predict(X[:128], raw_score=True)
+    after = compile_cache.trace_count()
+    assert after == before, (
+        f"second identically-shaped run retraced {after - before} "
+        f"program(s); registry key is missing some trace constant")
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-9)
+
+
+def test_trace_once_aligned_path():
+    _assert_trace_once(ALIGNED)
+
+
+def test_trace_once_default_fused_path():
+    _assert_trace_once(None)
+
+
+def test_registry_grows_for_new_shape():
+    """A genuinely new shape is allowed (and expected) to trace."""
+    X, y = _data(n=900)
+    _train(X, y, ALIGNED)
+    before = compile_cache.trace_count()
+    X2, y2 = _data(seed=5, n=1300)
+    _train(X2, y2, ALIGNED)
+    assert compile_cache.trace_count() > before
