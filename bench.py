@@ -25,9 +25,14 @@ is the full record; a killed run still leaves the stages that finished):
    "predict_speedup": <serve engine vs seed TreePredictor>}
 
 Stages run in value order (63-bin -> 255-bin -> MSLR -> predict ->
-valid-overhead -> reference parity) and BENCH_BUDGET_S sets a wall-clock
-budget: once exceeded, remaining stages are skipped (recorded under
-"budget_skipped") instead of the whole run timing out with no output.
+valid-overhead -> warm-rerun -> reference parity LAST) and
+BENCH_BUDGET_S sets a wall-clock budget: once exceeded, remaining
+stages are skipped instead of the whole run timing out with no output.
+EVERY skipped stage records its reason (budget exhaustion or the env
+knob that disabled it) under "stage_skips" {stage: reason} — and the
+summary line re-emits at the moment of the skip, so a later hard kill
+can never produce rc=124 with nothing parseable. "budget_skipped"
+(name-only list) stays for older parsers.
 
 Compile-cost accounting (first-class JSON fields): "warmup_s" /
 "warmup_s_255bin" (wall seconds of the warmup iterations, compile
@@ -35,13 +40,20 @@ included), "compile_s" / "compile_s_255bin" (warmup minus steady-state
 iteration cost), "compile_cache_hit" (persistent cache had entries
 before this process compiled), "compile_cache" {dir, entries_before,
 entries_after}, and "warmup_s_warm" + "warm_speedup" from a
-fresh-process rerun of the 63-bin warmup leg (stage 1b).
+fresh-process rerun of the 63-bin warmup leg (warm-rerun stage).
+
+Aligned-path accounting: the 255-bin and MSLR stages record whether the
+run stayed on the aligned engine ("aligned_255bin" / "mslr_aligned"),
+its host-fallback count ("fallbacks_255bin" / "mslr_fallbacks"), and
+whether the slot-hist store spilled to HBM through the DMA ring
+("hist_spill_255bin" / "mslr_hist_spill").
 
 Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
-BENCH_SKIP_WARM=1. LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR
-override the persistent-cache location (default: ./.jax_cache).
+BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1.
+LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
+persistent-cache location (default: ./.jax_cache).
 """
 import json
 import os
@@ -95,14 +107,25 @@ def budget_left():
     return BUDGET_S - (time.perf_counter() - _T0)
 
 
-def budget_gate(out, stage):
-    """True when the stage still fits the budget; records the skip when
-    it doesn't."""
+def stage_gate(out, stage, env_knob=None):
+    """True when the stage should run. A skipped stage records WHY under
+    out["stage_skips"][stage] — the env knob that disabled it, or budget
+    exhaustion — and re-emits the summary line immediately, so a later
+    hard kill still leaves the skip reasons parseable on stdout."""
+    if env_knob and os.environ.get(env_knob) == "1":
+        out.setdefault("stage_skips", {})[stage] = f"{env_knob}=1"
+        emit(out)
+        return False
     left = budget_left()
     if left is None or left > 0:
         return True
-    log(f"# budget exhausted ({BUDGET_S:.0f}s): skipping {stage}")
+    elapsed = time.perf_counter() - _T0
+    reason = (f"BENCH_BUDGET_S={BUDGET_S:.0f} exhausted "
+              f"({elapsed:.0f}s elapsed)")
+    log(f"# {reason}: skipping {stage}")
     out.setdefault("budget_skipped", []).append(stage)
+    out.setdefault("stage_skips", {})[stage] = reason
+    emit(out)
     return False
 
 
@@ -253,16 +276,24 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
         # i.e. the trace + XLA-compile (or cache-load) bill of the stage
         "compile_s": round(max(t_warm - warmup * per_iter, 0.0), 2),
         "per_iter_ms": round(per_iter * 1e3, 2),
+        "aligned": eng is not None,
+        "fallbacks": fb if eng is not None else None,
+        "hist_spill": bool(getattr(eng, "hist_spill", False))
+        if eng is not None else False,
     }
     return per_iter * BASELINE_ITERS, auc, done, stats
 
 
-def run_mslr(n, f, iters, warmup):
+def run_mslr(n, f, iters, warmup, max_bin=255):
+    """MSLR-shaped lambdarank run. Defaults to max_bin=255 — the
+    reference table's configuration (docs/Experiments.rst:110), and the
+    wide-F x 255-bin shape that exercises the HBM slot-hist spill ring on
+    the aligned path (F=137 slot blocks no longer fit the VMEM budget)."""
     X, y, group = synth_mslr(n, f)
     params = {
         "objective": "lambdarank",
         "num_leaves": 255,
-        "max_bin": 63,
+        "max_bin": max_bin,
         "learning_rate": 0.1,
         "min_data_in_leaf": 50,
         "verbosity": -1,
@@ -294,9 +325,21 @@ def run_mslr(n, f, iters, warmup):
         gsub.append(q)
         tot += q
     nd = ndcg_at(preds[:tot], y[:tot], gsub, 10)
-    log(f"# mslr: bin={t_bin:.1f}s warmup({warmup})={t_warm:.1f}s "
-        f"per_iter={per_iter * 1e3:.1f}ms ndcg10={nd:.5f}")
-    return per_iter * BASELINE_ITERS, nd
+    eng = getattr(bst._gbdt, "_aligned_eng_ref", None)
+    info = {
+        "max_bin": max_bin,
+        "aligned": eng is not None,
+        "fallbacks": getattr(eng, "fallbacks", 0)
+        if eng is not None else None,
+        "hist_spill": bool(getattr(eng, "hist_spill", False))
+        if eng is not None else False,
+    }
+    log(f"# mslr mb={max_bin}: bin={t_bin:.1f}s warmup({warmup})="
+        f"{t_warm:.1f}s per_iter={per_iter * 1e3:.1f}ms ndcg10={nd:.5f} "
+        f"aligned={'yes' if info['aligned'] else 'no'} "
+        f"spill={'yes' if info['hist_spill'] else 'no'} "
+        f"fallbacks={info['fallbacks']}")
+    return per_iter * BASELINE_ITERS, nd, info
 
 
 def run_valid_overhead(X, y, hX, hy, leaves, iters, warmup):
@@ -513,15 +556,10 @@ def main() -> None:
             pass
     emit(out)
 
-    # ---- stage 1b: fresh-process warm rerun (certifies the persistent
-    # cache: the child re-pays binning but should load, not compile) ----
-    if os.environ.get("BENCH_SKIP_WARM") != "1" \
-            and budget_gate(out, "warm_rerun"):
-        run_warm_rerun(out)
-        emit(out)
-
-    # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table) ----
-    if os.environ.get("BENCH_SKIP_255") != "1" and budget_gate(out, "255bin"):
+    # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table;
+    # runs BEFORE the warm rerun / parity extras — it is the headline
+    # gap this repo is closing, so a budget kill must not eat it) -------
+    if stage_gate(out, "255bin", "BENCH_SKIP_255"):
         projected255, auc255, done255, stats255 = run_higgs(
             n, f, leaves, max(iters // 2, 2), warmup, 255,
             hX if full else None, hy if full else None, X, y,
@@ -529,26 +567,33 @@ def main() -> None:
         out["value_255bin"] = round(projected255, 2)
         out["warmup_s_255bin"] = stats255["warmup_s"]
         out["compile_s_255bin"] = stats255["compile_s"]
+        out["aligned_255bin"] = stats255["aligned"]
+        out["fallbacks_255bin"] = stats255["fallbacks"]
+        out["hist_spill_255bin"] = stats255["hist_spill"]
         if full and auc255 is not None:
             out["auc_ours_full_255bin"] = round(auc255, 6)
             if done255 < full:
                 out["full_iters_done_255bin"] = done255
         emit(out)
 
-    # ---- stage 3: MSLR lambdarank (second headline experiment) ---------
-    if os.environ.get("BENCH_SKIP_RANK") != "1" and budget_gate(out, "mslr"):
+    # ---- stage 3: MSLR lambdarank (second headline experiment; 255-bin
+    # x F=137 — the aligned-path spill-ring shape) -----------------------
+    if stage_gate(out, "mslr", "BENCH_SKIP_RANK"):
         nm = 30_000 if smoke else 2_270_000
         fm = 20 if smoke else 137
         rit = 4 if smoke else 25
-        mslr_s, nd = run_mslr(nm, fm, rit, 2)
+        mslr_s, nd, minfo = run_mslr(nm, fm, rit, 2, max_bin=255)
         out["ndcg10"] = round(nd, 6)
         out["mslr_500iter_s"] = round(mslr_s, 2)
         out["mslr_vs_baseline"] = round(BASELINE_MSLR_S / mslr_s, 3)
+        out["mslr_max_bin"] = minfo["max_bin"]
+        out["mslr_aligned"] = minfo["aligned"]
+        out["mslr_fallbacks"] = minfo["fallbacks"]
+        out["mslr_hist_spill"] = minfo["hist_spill"]
         emit(out)
 
     # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --
-    if os.environ.get("BENCH_SKIP_PREDICT") != "1" \
-            and budget_gate(out, "predict"):
+    if stage_gate(out, "predict", "BENCH_SKIP_PREDICT"):
         try:
             from tools.bench_predict import run as bench_predict_run
             pred = bench_predict_run(
@@ -563,8 +608,7 @@ def main() -> None:
         emit(out)
 
     # ---- stage 5: valid-set overhead (diagnostic) ----------------------
-    if os.environ.get("BENCH_SKIP_VALID") != "1" \
-            and budget_gate(out, "valid_overhead"):
+    if stage_gate(out, "valid_overhead", "BENCH_SKIP_VALID"):
         vo_iters = 3 if smoke else 10
         per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
                                        leaves, vo_iters, 2)
@@ -573,9 +617,16 @@ def main() -> None:
             (per_valid / base_per - 1.0) * 100.0, 1)
         emit(out)
 
-    # ---- stage 6: reference-binary parity (slowest, least perishable) --
-    if os.environ.get("BENCH_SKIP_REF") != "1" and not smoke \
-            and budget_gate(out, "ref_parity"):
+    # ---- stage 6: fresh-process warm rerun (certifies the persistent
+    # cache: the child re-pays binning but should load, not compile) ----
+    if stage_gate(out, "warm_rerun", "BENCH_SKIP_WARM"):
+        run_warm_rerun(out)
+        emit(out)
+
+    # ---- stage 7: reference-binary parity (slowest, least perishable) --
+    if smoke:
+        out.setdefault("stage_skips", {})["ref_parity"] = "BENCH_SMOKE=1"
+    elif stage_gate(out, "ref_parity", "BENCH_SKIP_REF"):
         auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
         if auc_ref is not None:
             out["auc_ours_1m_100it"] = round(auc_ours_1m, 6)

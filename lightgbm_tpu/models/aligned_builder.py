@@ -45,7 +45,8 @@ from ..ops.aligned import (META_BAG, META_LABEL, META_LABEL_MASK,
                            META_RID_MASK, R_CAT,
                            R_COPY, R_DL, R_MT, R_SHIFT, _bpw_for_bits,
                            count_pass, lane_layout, move_pass,
-                           pack_records, slot_hist_pass)
+                           pack_records, pack_route2, slot_hist_pass)
+from ..utils import log
 from ..ops.histogram import NUM_HIST_STATS
 from .device_learner import (BF_GAIN, BF_LG, BF_LH, BF_LOUT, BF_RG, BF_RH,
                              BF_ROUT, BF_W, BI_DEFLEFT, BI_FEAT, BI_ISCAT,
@@ -193,7 +194,7 @@ class AlignedEngine:
                 and weight is None and lab01
                 and learner.n <= (1 << 24)   # rid must fit 24 meta bits
                 # tpu_force_big_n exercises the big-n physical layout
-                # (exact i32 count pass + 9-bit route repack) at small n,
+                # (exact i32 count pass + route-word repack) at small n,
                 # which the compact layout would otherwise shadow
                 and not bool(getattr(self.cfg, "tpu_force_big_n", False)))
         with_prob = self.mc_mode == "prob"
@@ -397,23 +398,26 @@ class AlignedEngine:
         cfg = self.cfg
         C, NC, S = self.C, self.NC, self.S
         Sm1 = S - 1
-        # per-round split cap = compact hist-store height: the move
-        # kernel's whole [K+1, ...] store is VMEM-resident, so K shrinks
-        # on wide-feature/high-bin shapes (e.g. F=137 at B=256 nibble
-        # blocks would need 216 MB at K=256) — fewer splits per round,
-        # more rounds, but the kernel still compiles
-        from ..ops.aligned import slot_hist_bytes
+        # per-round split cap: K=256 unconditionally — when the move
+        # kernel's [K+1, ...] hist store exceeds the VMEM budget it no
+        # longer shrinks K (the old K=64 fallback cost rounds AND still
+        # blew VMEM at F=137 x 255 bins); the store SPILLS to HBM and
+        # streams through the kernel's 2-deep DMA staging ring instead
+        from ..ops.aligned import hist_layout
         _bh = lr.hist_bins if lr.bundled else lr.max_bin_global
-        slot_bytes = slot_hist_bytes(self.ncols, _bh)
         import os as _os
-        kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0)
-        if not kcap:
-            # K=256 only while the whole [K+1] store stays under ~48 MB
-            # of VMEM (HIGGS-255 nibble store 44 MB measured fine);
-            # beyond that the kernel slows ~3x (F=137 cliff) -> K=64,
-            # the floor (K=32/48 faulted the TPU worker at wide F)
-            kcap = 256 if slot_bytes * 257 <= (48 << 20) else 64
+        kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0) or 256
         K = min(Sm1, kcap)
+        subbin, spill, slot_bytes, spill_budget = hist_layout(
+            cfg, self.ncols, _bh, K)
+        self.hist_subbin, self.hist_spill = subbin, spill
+        if spill and not getattr(self, "_spill_logged", False):
+            self._spill_logged = True
+            log.info(
+                f"aligned: slot-hist spilled to HBM "
+                f"({slot_bytes >> 10} KB/slot x {K + 1} slots > "
+                f"{spill_budget >> 20} MB VMEM budget; "
+                f"2-deep DMA ring, K stays {K})")
         Lm1_commit = max(self.cfg.num_leaves - 1, 1)
         F = lr.num_features
         B = lr.max_bin_global
@@ -679,7 +683,8 @@ class AlignedEngine:
                                            bag_lane=bag_lane, bits=bits,
                                            grad_fn=gfn, num_class=K_cls,
                                            gh_off=self.gh_off,
-                                           interpret=interpret)
+                                           interpret=interpret,
+                                           subbin=subbin)
             root_hist = _gsum(root_hist_all[0])
             root_g = jnp.sum(root_hist[0, :, 0])
             root_h = jnp.sum(root_hist[0, :, 1])
@@ -808,11 +813,11 @@ class AlignedEngine:
                     jnp.where(sel[:, None],
                               lax.bitcast_convert_type(bestB, jnp.int32),
                               0)).reshape(-1)
-                r2_s = (jnp.clip(db_dev[feat], 0, 511)
-                        | (jnp.clip(nb_dev[feat], 0, 511) << 9))
-                if bundled:
-                    r2_s = r2_s | (boff_dev[feat] << 18) \
-                        | (bpk_dev[feat] << 27)
+                r2_s = pack_route2(
+                    jnp.clip(db_dev[feat], 0, 255),
+                    jnp.clip(nb_dev[feat], 1, 256),
+                    boff_dev[feat] if bundled else 0,
+                    bpk_dev[feat] if bundled else 0)
                 r1_pc = r1_s[slot_of]
                 r2_pc = r2_s[slot_of]
                 wsel_pc = wsel_s[slot_of]
@@ -882,7 +887,8 @@ class AlignedEngine:
                                       w_used=self.w_used,
                                       gh_off=self.gh_off,
                                       bundled=bundled,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      subbin=subbin, spill=spill)
 
                 # ---- updated tables (begins relaid for ALL slots)
                 depth_new = leafI[:, LI_DEPTH] + 1

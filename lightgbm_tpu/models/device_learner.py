@@ -1166,28 +1166,49 @@ class DeviceTreeLearner:
         # non-pointwise objectives pay a row-order gradient round-trip
         # (materialize + gather); the ext record layout (round 5) plus the
         # [K]-compact hist/eval path made this a win at the MSLR shape
-        # (2.27M x 137 at 63 bins: 562 vs the fused 1264 ms/iter) — but
-        # only while the per-slot histogram block is small enough for a
-        # workable K (wide-F x 256-bin nibble blocks force K=64 AND still
-        # blow VMEM: MSLR at 255 bins measured 2.06 s vs fused 1.26).
-        # Gate: a row floor where the round-trip amortizes plus the
-        # slot-block budget; forced tpu_grow_mode=aligned bypasses both.
+        # (2.27M x 137 at 63 bins: 562 vs the fused 1264 ms/iter).
+        # The old slot-block VMEM budget clause is GONE: oversized
+        # stores (wide-F x 255-bin) now spill to HBM behind the move
+        # pass's DMA staging ring instead of faulting (see
+        # aligned_gate_notes), so only the row floor remains; forced
+        # tpu_grow_mode=aligned bypasses it.
         if not (objective.point_grad_fn() is not None
                 or objective.num_model_per_iteration > 1
-                or (self.n >= 1_000_000
-                    and self._aligned_slot_bytes() <= (512 << 10))
+                or self.n >= 1_000_000
                 or mode == "aligned"):
             return "non-pointwise objective below the row floor"
         return None
 
-    def _aligned_slot_bytes(self) -> int:
-        """Bytes of ONE slot's histogram block in the aligned engine's
-        VMEM-resident stores (shared with the K-cap driver)."""
-        from ..ops.aligned import slot_hist_bytes
-        bh = self.hist_bins if self.bundled else self.max_bin_global
-        ncols = (len(np.asarray(self.ds.bundles.group_num_bin))
-                 if self.bundled else self.num_features)
-        return slot_hist_bytes(ncols, bh)
+    def aligned_gate_notes(self):
+        """INFO notes about HOW the aligned path will run — distinct
+        from aligned_mode_gate, whose non-None return means the path is
+        NOT taken. Today: the slot-hist HBM spill. Spilling is not a
+        fallback (the kernels still run aligned, the store just streams
+        through the 2-deep VMEM DMA ring), so it must not surface as a
+        gate failure — but a run whose histograms moved to HBM is a
+        different performance regime, and path observability (VERDICT
+        r5 #8) requires the log to say so."""
+        from ..ops.aligned import hist_layout
+        from .level_builder import spec_slots
+        notes = []
+        try:
+            bh = self.hist_bins if self.bundled else self.max_bin_global
+            ncols = (len(np.asarray(self.ds.bundles.group_num_bin))
+                     if self.bundled else self.num_features)
+            import os
+            kcap = int(os.environ.get("LGBT_KCAP", "0") or 0) or 256
+            S = spec_slots(self.cfg.num_leaves,
+                           float(getattr(self.cfg, "tpu_level_spec", 1.5)))
+            K = min(max(S - 1, 1), kcap)
+            subbin, spill, slot_bytes, budget = hist_layout(
+                self.cfg, ncols, bh, K)
+            if spill:
+                notes.append(
+                    f"slot-hist spilled to HBM ({slot_bytes >> 10} KB/"
+                    f"slot x {K + 1} slots > {budget >> 20} MB)")
+        except Exception:       # notes are best-effort observability
+            pass
+        return notes
 
     def aligned_engine(self, objective, init_row_scores=None,
                        bagged=False, num_class=1):

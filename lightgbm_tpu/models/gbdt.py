@@ -390,6 +390,17 @@ class GBDT:
         self._path_logged = True
         from ..utils import log
         msg = f"training path: {path}"
+        if path.startswith("aligned"):
+            # info gate-notes: the path IS aligned, but e.g. the
+            # slot-hist store spilled to HBM — a different perf regime
+            # the log must name (not a fallback)
+            gate_notes = getattr(self.learner, "aligned_gate_notes", None)
+            if gate_notes is not None:
+                try:
+                    for note in gate_notes():
+                        msg += f" ({note})"
+                except Exception:
+                    pass
         if not path.startswith("aligned"):
             why = None
             gate = getattr(self.learner, "aligned_mode_gate", None)

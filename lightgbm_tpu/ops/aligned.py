@@ -75,10 +75,12 @@ R_MT = 14          # bits 14..15 missing type
 R_COPY = 16        # bit 16      copy-through (unsplit block)
 R_WSEL = 17        # bits 17..24 split word lane of the block
 R_CAT = 25         # bit 25      categorical split (bitset routing)
-# route word 2: default_bin | num_bin << 9 | boff << 18 | bpk << 27
-# (9-bit bin fields: num_bin <= 256; boff/bpk are the EFB bundle unpack
-# params — one packed word keeps the scalar-prefetch SMEM budget at
-# 6 x NC words, bounding NC ~40K chunks = ~40M rows at C=1024)
+# route word 2: default_bin | (num_bin - 1) << 8 | boff << 16 | bpk << 24
+# (8-bit bin fields — num_bin <= 256 stores as num_bin - 1, so the whole
+# word fits 25 bits; boff/bpk are the EFB bundle unpack params — one
+# packed word keeps the scalar-prefetch SMEM budget at 6 x NC words,
+# bounding NC ~40K chunks = ~40M rows at C=1024). pack_route2 is the
+# single encode point; _unpack_bundle/_goes_left decode.
 # meta word: cnt | first << 20 | last << 21
 
 
@@ -266,6 +268,21 @@ def pack_records(bins: np.ndarray, label: np.ndarray,
 # ---------------------------------------------------------------------------
 # move pass
 # ---------------------------------------------------------------------------
+def pack_route2(db, nb, boff=0, bpk=0):
+    """Encode route word 2: db | (nb - 1) << 8 | boff << 16 | bpk << 24.
+
+    num_bin stores BIASED (nb - 1 <= 255) so every field is 8 bits and
+    the word stays within 25 bits — the narrow fields are what lets the
+    split threshold/bin arithmetic stay 8-bit end to end at
+    max_bin = 255. Single encode point: the aligned builder and the
+    kernel-parity tests both construct r2 through this helper, so the
+    layout can never drift between encoder and the in-kernel decoders
+    (_unpack_bundle/_goes_left). Works on python ints, numpy and jax
+    arrays alike."""
+    return ((db & 255) | (((nb - 1) & 255) << 8) | ((boff & 255) << 16)
+            | ((bpk & 1) << 24))
+
+
 def _unpack_bundle(binv, r2):
     """EFB: BUNDLE column value -> the split feature's own bin — MUST
     stay bit-identical to ops/partition.bundle_unpack (the valid-set
@@ -274,12 +291,13 @@ def _unpack_bundle(binv, r2):
     equivalence over the full domain). This arithmetic-select form
     exists because Mosaic cannot broadcast the scalar bpk bool into a
     vector select (arith.trunci to i1 fails in-kernel). r2 packs the
-    feature-space default_bin/num_bin plus boff/bpk. Must run BEFORE
-    _cat_word/_goes_left — both consume feature-space bins."""
-    db = r2 & 511
-    nb = (r2 >> 9) & 511
-    boff = (r2 >> 18) & 255
-    bpk = (r2 >> 27) & 1
+    feature-space default_bin/num_bin plus boff/bpk (see pack_route2).
+    Must run BEFORE _cat_word/_goes_left — both consume feature-space
+    bins."""
+    db = r2 & 255
+    nb = ((r2 >> 8) & 255) + 1
+    boff = (r2 >> 16) & 255
+    bpk = (r2 >> 24) & 1
     p = binv - boff
     in_range = ((p >= 0) & (p < nb - 1)).astype(jnp.int32)
     b = jnp.where(p >= db, p + 1, p)
@@ -300,8 +318,8 @@ def _goes_left(binv, r1, r2, valid, catw=None):
     dl = (r1 >> R_DL) & 1                      # scalar 0/1
     mt = (r1 >> R_MT) & 3
     copy = (r1 >> R_COPY) & 1
-    db = r2 & 511
-    nb = (r2 >> 9) & 511
+    db = r2 & 255
+    nb = ((r2 >> 8) & 255) + 1
     base = (binv <= thr).astype(jnp.int32)     # vector 0/1
     mtz = jnp.int32(0) + ((mt == MISSING_ZERO_C).astype(jnp.int32))
     mtn = (mt == MISSING_NAN_C).astype(jnp.int32)
@@ -376,14 +394,53 @@ def _nibble_hist(b_pad: int) -> bool:
     return b_pad > 128
 
 
-def _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C):
+def _hist_mode(b_pad: int, subbin: bool = False) -> str:
+    """Histogram accumulation mode for a bin width.
+
+    "group": full-width one-hot, features batched per MXU issue
+    (b_pad <= 128). Above 128 bins the one-hot build cost forces a
+    factored form: "nibble" (legacy bit-3 payload split x 128-wide
+    one-hot — 130 compares per row/feature) or "subbin" (hi/lo 4-bit
+    halves: TWO 16-wide one-hots, 32 compares, one [16,C]x[128,C] MXU
+    issue into a [16, 128] = [lo, pay*16+hi] tile — exactly two f32
+    VMEM tiles). subbin is the tpu_hist_subbin knob resolved by the
+    caller; it only applies where the factored form is needed."""
+    if b_pad > 128:
+        return "subbin" if subbin else "nibble"
+    return "group"
+
+
+def _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C,
+                subbin=False):
     """Accumulate one chunk's histogram contributions.
 
     pay6: [6, C] hi/lo payload; bin_of(f) -> [C] i32 bin values;
     accum(idx, contrib) adds into the store — grouped one-hot indexes by
     group id with [6, group*b_pad] blocks, nibble mode by feature with
-    [96, 16] = [6*lo, hi] blocks."""
-    if _nibble_hist(b_pad):
+    [96, 16] = [6*lo, hi] blocks, subbin mode by feature with [16, 128]
+    = [lo, pay*16 + hi] blocks (cols >= 96 stay zero)."""
+    mode = _hist_mode(b_pad, subbin)
+    if mode == "subbin":
+        # sub-binned accumulation: bin = hi*16 + lo. The payload rides
+        # the HI one-hot (Z = pay6 x oh_hi -> [96, C], zero-padded to a
+        # full [128, C] tile) and ONE MXU contraction against the 16-wide
+        # LO one-hot lands the whole [16, 128] sub-bin tile — 32 VPU
+        # compares per (row, feature) vs the nibble form's 130, and the
+        # tile folds to [256, 3] once per store finalize instead of
+        # per-chunk repacking.
+        iota16 = lax.broadcasted_iota(jnp.int32, (16, C), 0)
+        for f in range(num_features):
+            bv = bin_of(f)
+            oh_hi = ((bv >> 4)[None, :] == iota16).astype(jnp.bfloat16)
+            oh_lo = ((bv & 15)[None, :] == iota16).astype(jnp.bfloat16)
+            Z = (pay6[:, None, :] * oh_hi[None, :, :]).reshape(96, C)
+            Zp = jnp.concatenate(
+                [Z, jnp.zeros((32, C), jnp.bfloat16)], axis=0)
+            contrib = lax.dot_general(oh_lo, Zp, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            accum(f, contrib)
+        return
+    if mode == "nibble":
         # factor bin = hi*16 + b3*8 + lo3 into a 2-row payload split
         # (bit 3) and a 128-wide one-hot (lo3*16 + hi): the [12, 128]
         # contrib tiles VMEM exactly (no 16-lane padding, no in-kernel
@@ -414,29 +471,66 @@ def _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C):
         accum(gi, contrib)
 
 
-def slot_hist_bytes(ncols: int, b_pad: int) -> int:
-    """Bytes of ONE slot's histogram block in the engine's VMEM-resident
-    stores — the single source of truth for the per-round split cap K
-    (aligned_builder) AND the non-pointwise routing gate
-    (device_learner.aligned_mode_ok)."""
+def slot_hist_bytes(ncols: int, b_pad: int, subbin: bool = False) -> int:
+    """Bytes of ONE slot's histogram block in the engine's histogram
+    stores — the single source of truth for the per-round VMEM budget
+    check that decides between the VMEM-resident store and the HBM
+    spill ring (aligned_builder / device_learner)."""
     group = 8 if b_pad <= 64 else 4
-    return 4 * int(np.prod(_hist_store_shape(0, ncols, b_pad, group)[1:]))
+    return 4 * int(np.prod(
+        _hist_store_shape(0, ncols, b_pad, group, subbin)[1:]))
 
 
-def _hist_store_shape(num_slots, num_features, b_pad, group):
+def hist_layout(cfg, ncols: int, bh: int, K: int):
+    """Resolve the aligned histogram store layout for a K-split round:
+    (subbin, spill, slot_bytes, budget_bytes).
+
+    subbin: the tpu_hist_subbin knob ("auto"/"on" enable the sub-binned
+    accumulation wherever the factored form applies, i.e. bh > 128;
+    "off" keeps the legacy nibble form). spill: True when the
+    [K+1]-slot store exceeds the tpu_hist_spill_vmem_mb VMEM budget —
+    the move pass then keeps the store in HBM behind the 2-deep DMA
+    staging ring instead of shrinking K. Shared between
+    AlignedEngine._build_program and the device learner's gate notes so
+    the logged path always matches the compiled kernel."""
+    knob = str(getattr(cfg, "tpu_hist_subbin", "auto") or "auto").lower()
+    subbin = knob != "off"
+    slot_bytes = slot_hist_bytes(ncols, bh, subbin)
+    budget = int(float(getattr(cfg, "tpu_hist_spill_vmem_mb", 48) or 48)
+                 * (1 << 20))
+    spill = slot_bytes * (K + 1) > budget
+    return subbin, spill, slot_bytes, budget
+
+
+def _hist_store_shape(num_slots, num_features, b_pad, group,
+                      subbin=False):
     """Per-pass histogram store shape (see _hist_accum layouts). The
-    nibble layout's [12, 128] blocks fill 128-lane tiles exactly — a
-    narrow minor dim would pad 8x in VMEM (353 MB at 257 slots)."""
-    if _nibble_hist(b_pad):
+    nibble layout's [12, 128] and the subbin layout's [16, 128] blocks
+    fill 128-lane tiles exactly — a narrow minor dim would pad 8x in
+    VMEM (353 MB at 257 slots)."""
+    mode = _hist_mode(b_pad, subbin)
+    if mode == "subbin":
+        return (num_slots + 1, num_features, 16, 128)
+    if mode == "nibble":
         return (num_slots + 1, num_features, 12, 128)
     ngroups = (num_features + group - 1) // group
     return (num_slots + 1, ngroups, 6, group * b_pad)
 
 
-def _hist_store_finalize(out, num_slots, num_features, b_pad, group):
+def _hist_store_finalize(out, num_slots, num_features, b_pad, group,
+                         subbin=False):
     """Store -> hist[num_slots, F, b_pad, 3] (hi+lo payload halves
-    combined; nibble mode also remaps bin = hi*16 + lo)."""
-    if _nibble_hist(b_pad):
+    combined; nibble/subbin modes also remap bin = hi*16 + lo)."""
+    mode = _hist_mode(b_pad, subbin)
+    if mode == "subbin":
+        # [ns+1, F, lo, pay*16 + hi] -> drop the 32 zero pad cols, fold
+        # the hi/lo payload halves, land bin = hi*16 + lo
+        h = out[..., :96].reshape(num_slots + 1, num_features, 16, 6, 16)
+        h = h[:, :, :, :3] + h[:, :, :, 3:]        # [ns,F,lo,3,hi]
+        h = jnp.transpose(h, (0, 1, 4, 2, 3))      # [ns,F,hi,lo,3]
+        h = h.reshape(num_slots + 1, num_features, 256, 3)
+        return h[:num_slots, :, :b_pad]
+    if mode == "nibble":
         h = out.reshape(num_slots + 1, num_features, 6, 2, 8, 16)
         h = h[:, :, :3] + h[:, :, 3:]              # [ns,F,3,b3,lo3,hi]
         h = jnp.transpose(h, (0, 1, 5, 3, 4, 2))   # [ns,F,hi,b3,lo3,3]
@@ -469,9 +563,10 @@ def _hi_lo6(pay):
 def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                  hslot_ref, cbits_ref, fetch_ref, rec_ref, rec_hbm_ref,
                  out_ref, hist_ref, stag,
-                 fbuf, hacc, cur_ref, sems, *, chunk, w_pad, w_used, wcnt,
-                 num_features, b_pad, group, dummy, bag_lane,
-                 bits, grad_fn, num_class, gh_off, bundled):
+                 fbuf, hacc, hstage, cur_ref, sems, *, chunk, w_pad,
+                 w_used, wcnt, num_features, b_pad, group, dummy,
+                 bag_lane, bits, grad_fn, num_class, gh_off, bundled,
+                 subbin, spill):
     """One grid step of the fused move+hist pass.
 
     SPLIT chunks: partition rows into the block's left/right staging
@@ -490,8 +585,20 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
     only after its previous DMA is waited on (pending flags in SMEM),
     and the final grid step drains all outstanding DMAs.
 
+    SPILL mode (static `spill`): the [K+1, ...] store is HBM-resident
+    instead of VMEM-resident — only the per-block hacc accumulator and
+    a 2-deep staging ring (hstage) live in VMEM. A slotted block's
+    finished hacc is copied to hstage[p] (p ping-pongs per slotted
+    block) and DMA'd to its HBM slot without waiting, overlapping the
+    next block's accumulation with the previous block's writeback. Each
+    slot is written by exactly ONE block per pass, so the DMA is a plain
+    overwrite; unvisited slots stay uninitialized and the wrapper masks
+    them to zero from hslots.
+
     cur_ref: [cur_l, cur_r, fl_l, fl_r, pend 4..15, dst 16..27,
-    src 28..39]; slots 0-3 = VMEM flush, 4-11 = HBM->HBM copy."""
+    src 28..39, spill_blk 40, spill_pend 41..42, spill_dst 43..44];
+    sems: slots 0-3 = VMEM flush, 4-11 = HBM->HBM copy,
+    12-13 = hist spill."""
     i = pl.program_id(0)
     C = chunk
     r1 = r1_ref[i]
@@ -502,9 +609,10 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
     def _():
         # SMEM scratch is NOT zero-initialized: clear the DMA pending
         # flags and saved src/dst indices before any use
-        for j in range(40):
+        for j in range(48):
             cur_ref[j] = 0
-        hist_ref[...] = jnp.zeros_like(hist_ref)
+        if not spill:
+            hist_ref[...] = jnp.zeros_like(hist_ref)
 
     @pl.when(((meta >> 20) & 1) != 0)     # first chunk of block
     def _():
@@ -535,6 +643,12 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
                                   sems.at[slot]).wait()
         cur_ref[4 + slot] = 0
 
+    def wait_spill(p):
+        pltpu.make_async_copy(hstage.at[p],
+                              hist_ref.at[cur_ref[43 + p]],
+                              sems.at[12 + p]).wait()
+        cur_ref[41 + p] = 0
+
     bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
 
@@ -558,7 +672,8 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
         def accum(idx, contrib):
             hacc[idx] += contrib
 
-        _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C)
+        _hist_accum(pay6, bin_of, accum, num_features, b_pad, group, C,
+                    subbin)
 
     # ---- copy fast-path: unsplit blocks shift as whole chunks — one
     # direct HBM->HBM DMA to the prefetched destination (bl): no fetch,
@@ -711,7 +826,28 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
 
         @pl.when((is_last != 0) & ((hs & 0xFFFFFF) != dummy))
         def _():
-            hist_ref[hs & 0xFFFFFF] += hacc[...]
+            if not spill:
+                hist_ref[hs & 0xFFFFFF] += hacc[...]
+            else:
+                # 2-deep spill ring: stage the finished block histogram
+                # and DMA it to its HBM slot WITHOUT waiting — the next
+                # block accumulates into hacc while this one drains.
+                # The staging buffer/semaphore is reused only after its
+                # previous DMA completed.
+                for p in range(2):
+                    @pl.when((cur_ref[40] & 1) == p)
+                    def _(p=p):
+                        @pl.when(cur_ref[41 + p] != 0)
+                        def _():
+                            wait_spill(p)
+                        hstage[p] = hacc[...]
+                        cur_ref[43 + p] = hs & 0xFFFFFF
+                        pltpu.make_async_copy(
+                            hstage.at[p],
+                            hist_ref.at[hs & 0xFFFFFF],
+                            sems.at[12 + p]).start()
+                        cur_ref[41 + p] = 1
+                cur_ref[40] = cur_ref[40] + 1
 
         @pl.when(is_last != 0)
         def _():
@@ -724,17 +860,22 @@ def _move_kernel(r1_ref, r2_ref, blbr_ref, meta_ref,
             @pl.when(cur_ref[4 + slot] != 0)
             def _():
                 wait_slot(slot)
+        if spill:
+            for p in range(2):
+                @pl.when(cur_ref[41 + p] != 0)
+                def _(p=p):
+                    wait_spill(p)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "chunk", "w_pad", "wcnt", "num_slots", "num_features", "b_pad",
     "group", "bag_lane", "bits", "grad_fn", "num_class", "w_used",
-    "gh_off", "bundled", "interpret"))
+    "gh_off", "bundled", "interpret", "subbin", "spill"))
 def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
               chunk, w_pad, wcnt, num_slots, num_features, b_pad, group,
               bag_lane=-1, bits=8, grad_fn=None, num_class=1,
               w_used=0, gh_off=2, bundled=False,
-              interpret=False):
+              interpret=False, subbin=False, spill=False):
     """Stable two-way partition of every block in one streaming pass,
     with the smaller-child histograms FUSED into the same pass.
 
@@ -756,19 +897,30 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
     Returns (records_out, hist[num_slots, F, b_pad, 3]). Chunks not
     covered by the new layout keep stale rows; hist slots never present
     in hslots are zero.
+
+    `spill` keeps the [num_slots+1, ...] store in HBM (streamed through
+    the kernel's 2-deep VMEM staging ring) instead of VMEM-resident —
+    the shape that lets wide-F x 255-bin rounds run with K well past
+    the VMEM budget. `subbin` selects the sub-binned accumulation at
+    b_pad > 128 (see _hist_mode).
     """
     compile_cache.note_trace()
     nc = records.shape[0]
     dummy = num_slots
-    store_shape = _hist_store_shape(num_slots, num_features, b_pad, group)
+    store_shape = _hist_store_shape(num_slots, num_features, b_pad,
+                                    group, subbin)
     hacc_shape = store_shape[1:]
+    # spill stages through a 2-deep ring; non-spill keeps a tiny dummy
+    # so the kernel signature is mode-independent
+    hstage_shape = (2,) + hacc_shape if spill else (2, 8, 128)
     kernel = functools.partial(_move_kernel, chunk=chunk, w_pad=w_pad,
                                w_used=w_used or w_pad,
                                wcnt=wcnt, num_features=num_features,
                                b_pad=b_pad, group=group, dummy=dummy,
                                bag_lane=bag_lane, bits=bits,
                                grad_fn=grad_fn, num_class=num_class,
-                               gh_off=gh_off, bundled=bundled)
+                               gh_off=gh_off, bundled=bundled,
+                               subbin=subbin, spill=spill)
     r1p = r1 | (wsel << R_WSEL)
     blbr = basel | (baser << 16)
     # copy chunks SKIP the blocked fetch: the block index carries the
@@ -787,8 +939,11 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
         ],
         out_specs=[
             pl.BlockSpec(memory_space=_HBM),
-            # constant index map: the compact hist store is resident in
-            # VMEM for the whole pass and written back once at the end
+            # spill: the store stays in HBM, written slot-by-slot by the
+            # kernel's DMA ring. Otherwise a constant index map keeps
+            # the compact store resident in VMEM for the whole pass,
+            # written back once at the end.
+            pl.BlockSpec(memory_space=_HBM) if spill else
             pl.BlockSpec(store_shape,
                          lambda i, a, b, c, d, e, f, g:
                          tuple(0 for _ in store_shape)),
@@ -797,8 +952,9 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
             pltpu.VMEM((4, w_pad, chunk), jnp.int32),
             pltpu.VMEM((4, w_pad, chunk), jnp.int32),   # flush bufs
             pltpu.VMEM(hacc_shape, jnp.float32),
-            pltpu.SMEM((40,), jnp.int32),
-            pltpu.SemaphoreType.DMA((12,)),
+            pltpu.VMEM(hstage_shape, jnp.float32),      # spill ring
+            pltpu.SMEM((48,), jnp.int32),
+            pltpu.SemaphoreType.DMA((14,)),
         ],
     )
     out, hist = pl.pallas_call(
@@ -812,8 +968,16 @@ def move_pass(records, r1, r2, basel, baser, meta, wsel, hslots, cbits,
             vmem_limit_bytes=100 << 20, has_side_effects=True),
         interpret=interpret,
     )(r1p, r2, blbr, meta, hslots, cbits, fetch_idx, records, records)
-    return out, _hist_store_finalize(hist, num_slots, num_features,
-                                     b_pad, group)
+    hist = _hist_store_finalize(hist, num_slots, num_features,
+                                b_pad, group, subbin)
+    if spill:
+        # HBM store slots are only written by visited blocks; mask the
+        # rest to zero (non-spill zeroes the whole store in-kernel)
+        visited = jnp.zeros((num_slots + 1,), jnp.int32) \
+            .at[hslots & 0xFFFFFF].max(1)
+        hist = jnp.where((visited[:num_slots] > 0)[:, None, None, None],
+                         hist, 0.0)
+    return out, hist
 
 
 # ---------------------------------------------------------------------------
@@ -904,7 +1068,8 @@ def count_pass(records, r1, r2, meta, wsel, kslots, cbits, num_slots,
 # ---------------------------------------------------------------------------
 def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
                       num_features, b_pad, group, chunk, wcnt, dummy,
-                      bag_lane, bits, grad_fn, num_class, gh_off):
+                      bag_lane, bits, grad_fn, num_class, gh_off,
+                      subbin):
     i = pl.program_id(0)
     bpw = _bpw_for_bits(bits)
     bmask = (1 << bits) - 1
@@ -933,15 +1098,16 @@ def _slot_hist_kernel(slots_ref, meta_ref, rec_ref, out_ref, *,
             out_ref[ks, idx] += contrib
 
         _hist_accum(pay6, bin_of, accum, num_features, b_pad, group,
-                    chunk)
+                    chunk, subbin)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "num_slots", "num_features", "b_pad", "chunk", "group", "wcnt",
-    "bag_lane", "bits", "grad_fn", "num_class", "gh_off", "interpret"))
+    "bag_lane", "bits", "grad_fn", "num_class", "gh_off", "interpret",
+    "subbin"))
 def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
                    chunk, group, wcnt, bag_lane=-1, bits=8, grad_fn=None,
-                   num_class=1, gh_off=2, interpret=False):
+                   num_class=1, gh_off=2, interpret=False, subbin=False):
     """hist[num_slots, F, b_pad, 3] over the record matrix.
 
     slots[i] maps chunk i to its accumulation slot (a COMPACT id —
@@ -954,12 +1120,14 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
     compile_cache.note_trace()
     nc = records.shape[0]
     dummy = num_slots
-    store_shape = _hist_store_shape(num_slots, num_features, b_pad, group)
+    store_shape = _hist_store_shape(num_slots, num_features, b_pad,
+                                    group, subbin)
     kernel = functools.partial(_slot_hist_kernel, num_features=num_features,
                                b_pad=b_pad, group=group, chunk=chunk,
                                wcnt=wcnt, dummy=dummy, bag_lane=bag_lane,
                                bits=bits, grad_fn=grad_fn,
-                               num_class=num_class, gh_off=gh_off)
+                               num_class=num_class, gh_off=gh_off,
+                               subbin=subbin)
     w_pad = records.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -978,7 +1146,7 @@ def slot_hist_pass(records, slots, meta, num_slots, num_features, b_pad,
         interpret=interpret,
     )(slots, meta, records)
     return _hist_store_finalize(out, num_slots, num_features, b_pad,
-                                group)
+                                group, subbin)
 
 
 def aligned_available() -> bool:

@@ -82,10 +82,77 @@ def _hist_kernel(bins_ref, pay_ref, out_ref, *, num_features: int,
         out_ref[f, :, :] += contrib
 
 
+def _subbin_body(bin_of, pay_ref, out_ref, num_features: int):
+    """Shared sub-binned accumulation body (max_bin > 128): bin =
+    hi*16 + lo. Instead of a B-wide one-hot (256 VPU compares per
+    row/feature), the payload rides the 16-wide HI one-hot
+    (Z = pay6 x oh_hi -> [96, C], zero-padded to a full [128, C] tile)
+    and ONE MXU contraction against the 16-wide LO one-hot lands the
+    whole [16, 128] = [lo, pay*16 + hi] sub-bin tile — 32 compares and
+    exactly two f32 VMEM tiles per feature. `bin_of(f)` -> [C] i32
+    lane-oriented bin values; pay_ref [3, C] (payload TRANSPOSED so the
+    hi/lo split concatenates on sublanes, no in-kernel relayout)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    pay_f32 = pay_ref[...]                       # [3, C]
+    p_hi = pay_f32.astype(jnp.bfloat16)
+    p_lo = (pay_f32 - p_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    pay6 = jnp.concatenate([p_hi, p_lo], axis=0)  # [6, C]
+    C = pay_f32.shape[1]
+    iota16 = lax.broadcasted_iota(jnp.int32, (16, C), 0)
+    for f in range(num_features):
+        bv = bin_of(f)
+        oh_hi = ((bv >> 4)[None, :] == iota16).astype(jnp.bfloat16)
+        oh_lo = ((bv & 15)[None, :] == iota16).astype(jnp.bfloat16)
+        Z = (pay6[:, None, :] * oh_hi[None, :, :]).reshape(96, C)
+        Zp = jnp.concatenate(
+            [Z, jnp.zeros((32, C), jnp.bfloat16)], axis=0)
+        contrib = lax.dot_general(oh_lo, Zp, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[f] += contrib
+
+
+def _subbin_rows_kernel(bins_ref, pay_ref, out_ref, *,
+                        num_features: int):
+    """Sub-binned kernel over gathered [C, F] uint8 rows."""
+    bins = bins_ref[...].astype(jnp.int32)
+    _subbin_body(lambda f: bins[:, f], pay_ref, out_ref, num_features)
+
+
+def _subbin_words_kernel(*refs, num_features: int, wcnt: int):
+    """Sub-binned kernel over packed lane-oriented bin words."""
+    word_refs = refs[:wcnt]
+    pay_ref = refs[wcnt]
+    out_ref = refs[wcnt + 1]
+
+    def bin_of(f):
+        w = word_refs[f >> 2][0, :]
+        return (w >> ((f & 3) * 8)) & 255
+
+    _subbin_body(bin_of, pay_ref, out_ref, num_features)
+
+
+def _subbin_finalize(out, num_features: int, max_bin: int) -> jax.Array:
+    """[F, 16, 128] = [lo, pay*16 + hi] sub-bin tiles -> [F, max_bin, 3]
+    (fold hi/lo payload halves, land bin = hi*16 + lo) — once per call,
+    not per chunk."""
+    h = out[..., :96].reshape(num_features, 16, 6, 16)
+    h = h[:, :, :NUM_STATS] + h[:, :, NUM_STATS:]    # [F, lo, 3, hi]
+    h = jnp.transpose(h, (0, 3, 1, 2))               # [F, hi, lo, 3]
+    return h.reshape(num_features, 256, NUM_STATS)[:, :max_bin]
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("max_bin", "chunk"))
+                   static_argnames=("max_bin", "chunk", "subbin",
+                                    "interpret"))
 def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
-                     max_bin: int, chunk: int = 1 << 11) -> jax.Array:
+                     max_bin: int, chunk: int = 1 << 11,
+                     subbin: bool = True, interpret: bool = False
+                     ) -> jax.Array:
     """hist[F, max_bin, 3] over contiguous (already gathered) rows.
 
     bins_rows: uint8 [P, F]; gh: f32 [P, 2]; valid: bool [P].
@@ -111,6 +178,22 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
         bins_rows = jnp.pad(bins_rows, ((0, pad), (0, 0)))
         pay = jnp.pad(pay, ((0, pad), (0, 0)))
 
+    if subbin and b_pad > 128:
+        kernel = functools.partial(_subbin_rows_kernel, num_features=f)
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((chunk, f), lambda i: (i, 0)),
+                pl.BlockSpec((NUM_STATS, chunk), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((f, 16, 128), lambda i: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((f, 16, 128), jnp.float32),
+            compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
+            interpret=interpret,
+        )(bins_rows, pay.T)
+        return _subbin_finalize(out, f, max_bin)
+
     w = 2 * NUM_STATS
     kernel = functools.partial(_hist_kernel, num_features=f, max_bin=b_pad,
                                payload_width=w)
@@ -124,6 +207,7 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
         out_specs=pl.BlockSpec((f, b_pad, w), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((f, b_pad, w), jnp.float32),
         compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
+        interpret=interpret,
     )(bins_rows, pay)
     # fold the lo-parts back into the hi sums; drop the bin padding
     return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
@@ -160,10 +244,13 @@ def _hist_words_kernel(*refs, num_features: int, max_bin: int,
 
 
 @functools.partial(jax.jit, static_argnames=("num_features", "max_bin",
-                                             "chunk"))
+                                             "chunk", "subbin",
+                                             "interpret"))
 def pallas_histogram_words(words, g: jax.Array, h: jax.Array,
                            valid: jax.Array, num_features: int,
-                           max_bin: int, chunk: int = 1 << 11) -> jax.Array:
+                           max_bin: int, chunk: int = 1 << 11,
+                           subbin: bool = True, interpret: bool = False
+                           ) -> jax.Array:
     """hist[F, max_bin, 3] over packed bin words (see
     `histogram.histogram_from_words` for the layout contract)."""
     p = g.shape[0]
@@ -178,6 +265,23 @@ def pallas_histogram_words(words, g: jax.Array, h: jax.Array,
     if pad:
         words2 = [jnp.pad(w, ((0, 0), (0, pad))) for w in words2]
         pay = jnp.pad(pay, ((0, pad), (0, 0)))
+    if subbin and b_pad > 128:
+        kernel = functools.partial(_subbin_words_kernel,
+                                   num_features=num_features, wcnt=wcnt)
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[pl.BlockSpec((1, chunk), lambda i: (0, i))
+                      for _ in range(wcnt)]
+            + [pl.BlockSpec((NUM_STATS, chunk), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((num_features, 16, 128),
+                                   lambda i: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((num_features, 16, 128),
+                                           jnp.float32),
+            compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
+            interpret=interpret,
+        )(*words2, pay.T)
+        return _subbin_finalize(out, num_features, max_bin)
     kernel = functools.partial(_hist_words_kernel,
                                num_features=num_features, max_bin=b_pad,
                                wcnt=wcnt)
@@ -192,6 +296,7 @@ def pallas_histogram_words(words, g: jax.Array, h: jax.Array,
         out_shape=jax.ShapeDtypeStruct((num_features, b_pad, 6),
                                        jnp.float32),
         compiler_params=_CompilerParams(vmem_limit_bytes=100 << 20),
+        interpret=interpret,
     )(*words2, pay)
     return (out[..., :NUM_STATS] + out[..., NUM_STATS:])[:, :max_bin, :]
 

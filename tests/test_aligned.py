@@ -63,8 +63,9 @@ def test_aligned_matches_leafwise_binary():
 
 
 def test_aligned_matches_leafwise_255bin():
-    """max_bin=255 exercises the NIBBLE histogram factorization
-    (b_pad=256: hi/lo 4-bit one-hots instead of a 256-row one-hot)."""
+    """max_bin=255 exercises the SUB-BINNED histogram factorization
+    (b_pad=256: hi/lo 4-bit one-hots contracted into a [16, 128] tile
+    on the MXU, folded to [256, 3] at pass finalize)."""
     X, y = _make()
     a = _train(X, y, "aligned", extra={"max_bin": 255})
     b = _train(X, y, "leafwise", extra={"max_bin": 255})

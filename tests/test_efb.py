@@ -197,12 +197,12 @@ def test_kernel_unpack_matches_bundle_unpack():
     partition form) over the full parameter domain."""
     import itertools
     import jax.numpy as jnp
-    from lightgbm_tpu.ops.aligned import _unpack_bundle
+    from lightgbm_tpu.ops.aligned import _unpack_bundle, pack_route2
     from lightgbm_tpu.ops.partition import bundle_unpack
     raw = jnp.arange(64, dtype=jnp.int32)
     for boff, bpk, db, nb in itertools.product(
-            (0, 1, 5, 40), (0, 1), (0, 2, 7), (2, 5, 20)):
-        r2 = db | (nb << 9) | (boff << 18) | (bpk << 27)
+            (0, 1, 5, 40), (0, 1), (0, 2, 7), (2, 5, 20, 256)):
+        r2 = pack_route2(db, nb, boff, bpk)
         a = np.asarray(_unpack_bundle(raw, jnp.int32(r2)))
         b = np.asarray(bundle_unpack(raw, boff, bpk, db, nb))
         np.testing.assert_array_equal(a, b, err_msg=str((boff, bpk, db, nb)))

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Per-term DEVICE-time breakdown of the 255-bin aligned round.
+
+Times each term of the round the way tools/device_time_r4.py does — the
+kernel chained k times inside one jitted fori_loop, per-exec seconds =
+(t_K - t_1) / (K - 1), so host dispatch / tunnel overhead cancels:
+
+  hist        slot_hist_pass over the full record store (root-shape,
+              sub-binned accumulation when the layout enables it)
+  route       move_pass with every block splitting and NO hist slots
+              (pure routing: decode + partition + compact store)
+  flush       hist-accumulating move_pass minus `route` — the marginal
+              cost of the fused sub-binned accumulate + slot flush
+              (through the HBM DMA ring when the layout spills)
+  split_eval  the jitted split finder over a [SPLITK, F, B, 3] batch
+              (the per-round changed-children evaluation)
+
+Emits ONE JSON line on stdout:
+  {"n": ..., "features": ..., "max_bin": 255, "chunk": ...,
+   "subbin": ..., "spill": ...,
+   "terms_ms": {"hist": ..., "route": ..., "flush": ...,
+                "split_eval": ...}}
+
+Env knobs: DT255_ROWS (default 10_500_000), DT255_FEATURES (28),
+DT255_CHUNK (1024), DT255_SPLITK (16), DT255_REPS (3), DT255_CHAIN (8),
+DT255_INTERPRET=1 (CPU interpret-mode kernels — the -m slow smoke test
+in tests/test_subbin_spill.py runs a tiny shape this way).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(os.environ.get("DT255_ROWS", 10_500_000))
+F = int(os.environ.get("DT255_FEATURES", 28))
+C = int(os.environ.get("DT255_CHUNK", 1024))
+SPLITK = int(os.environ.get("DT255_SPLITK", 16))
+REPS = int(os.environ.get("DT255_REPS", 3))
+CHAIN = int(os.environ.get("DT255_CHAIN", 8))
+INTERPRET = os.environ.get("DT255_INTERPRET") == "1"
+MB = 255
+S = 64
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def dget(x):
+    return np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(x)[0].reshape(-1)[:1]))
+
+
+def dev_time(mk_fn, *args):
+    """mk_fn(k) -> jitted fn running the kernel k times; returns per-exec
+    seconds from the k=1 vs k=CHAIN delta."""
+    f1, fK = mk_fn(1), mk_fn(CHAIN)
+    for f in (f1, fK):          # compile + warm
+        dget(f(*args))
+    ts = []
+    for f in (f1, fK):
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = f(*args)
+        dget(out)
+        ts.append((time.perf_counter() - t0) / REPS)
+    return max((ts[1] - ts[0]) / (CHAIN - 1), 0.0)
+
+
+def main():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ops.aligned import hist_layout, move_pass, \
+        pack_records, pack_route2, slot_hist_pass
+    from lightgbm_tpu.ops.split import SplitHyper, make_split_finder
+
+    cfg = Config()
+    rng = np.random.RandomState(3)
+    bins = rng.randint(0, MB, (N, F)).astype(np.uint8)
+    label = rng.randint(0, 2, N).astype(np.float32)
+    group = 4
+    B = 256
+
+    rec_np, wcnt, W, cnts, _bits = pack_records(bins, label, None, C)
+    nc_data = rec_np.shape[0]
+    NC = nc_data + 4
+    fullr = np.zeros((NC, W, C), np.int32)
+    fullr[:nc_data] = rec_np
+    rec = jnp.asarray(fullr)
+    del fullr
+    meta_cnt = np.zeros(NC, np.int32)
+    meta_cnt[:nc_data] = cnts
+    subbin, spill, slot_bytes, budget = hist_layout(cfg, F, B, S)
+    log(f"# n={N} F={F} C={C} chunks={nc_data} subbin={subbin} "
+        f"spill={spill} ({slot_bytes >> 10} KB/slot, "
+        f"budget {budget >> 20} MB)")
+
+    out = {"n": N, "features": F, "max_bin": MB, "chunk": C,
+           "subbin": subbin, "spill": spill, "terms_ms": {}}
+
+    # ---- route / flush: every block splits at mid-bin -----------------
+    r1 = np.full(NC, (MB // 2) | (1 << 13), np.int32)
+    meta = meta_cnt.copy()
+    meta[0] |= 1 << 20
+    meta[nc_data - 1] |= 1 << 21
+    r2 = np.full(NC, pack_route2(0, B), np.int32)
+    basel = np.zeros(NC, np.int32)
+    baser = np.full(NC, nc_data // 2, np.int32)
+    wsel = np.zeros(NC, np.int32)
+    nohist = np.full(NC, S + 1, np.int32)
+    cb0 = jnp.zeros((S + 2) * 8, jnp.int32)
+
+    def mk_move(hsl):
+        a = tuple(jnp.asarray(x) for x in
+                  (r1, r2, basel, baser, meta, wsel, hsl))
+
+        def mk(k):
+            @jax.jit
+            def f(r):
+                def body(i, r):
+                    r2_, _ = move_pass(r, *a, cb0, C, W, wcnt, S + 1, F,
+                                       B, group, interpret=INTERPRET,
+                                       subbin=subbin, spill=spill)
+                    return r2_
+                return lax.fori_loop(0, k, body, r)
+            return f
+        return mk
+
+    for name, hsl in (("route", nohist),
+                      ("hist_move", np.zeros(NC, np.int32))):
+        try:
+            per = dev_time(mk_move(hsl), rec)
+            out["terms_ms"][name] = round(per * 1e3, 2)
+            log(f"# {name}: {per * 1e3:.1f}ms ({per / N * 1e9:.2f}ns/row)")
+        except Exception as e:
+            log(f"# {name} FAILED {type(e).__name__} {str(e)[:200]}")
+            out["terms_ms"][name] = None
+    if out["terms_ms"].get("hist_move") is not None \
+            and out["terms_ms"].get("route") is not None:
+        out["terms_ms"]["flush"] = round(
+            max(out["terms_ms"].pop("hist_move")
+                - out["terms_ms"]["route"], 0.0), 2)
+
+    # ---- hist: the full root-shape slot_hist_pass ---------------------
+    slots = np.zeros(NC, np.int32)
+    slots[nc_data:] = S + 1
+    sl_j = jnp.asarray(slots)
+    mc_j = jnp.asarray(meta_cnt)
+
+    def mk_hist(k):
+        @jax.jit
+        def f(r):
+            def body(i, carry):
+                r, acc = carry
+                h = slot_hist_pass(r, sl_j, mc_j, S + 1, F, B, C, group,
+                                   wcnt, interpret=INTERPRET,
+                                   subbin=subbin)
+                r = r.at[0, 0, 0].add(1)
+                return (r, acc + h[0, 0, 0, 0])
+            return lax.fori_loop(0, k, body, (r, jnp.float32(0.0)))
+        return f
+
+    try:
+        per = dev_time(mk_hist, rec)
+        out["terms_ms"]["hist"] = round(per * 1e3, 2)
+        log(f"# hist: {per * 1e3:.1f}ms ({per / N * 1e9:.2f}ns/row)")
+    except Exception as e:
+        log(f"# hist FAILED {type(e).__name__} {str(e)[:200]}")
+        out["terms_ms"]["hist"] = None
+
+    # ---- split_eval: the finder over a changed-children batch ---------
+    fmeta = {
+        "num_bin": np.full(F, B, np.int32),
+        "default_bin": np.zeros(F, np.int32),
+        "missing_type": np.zeros(F, np.int32),
+        "bin_type": np.zeros(F, np.int32),
+        "monotone": np.zeros(F, np.int32),
+        "penalty": np.ones(F, np.float32),
+    }
+    finder = make_split_finder(SplitHyper.from_config(cfg), fmeta, B)
+    hist_b = jnp.asarray(
+        rng.rand(SPLITK, F, B, 3).astype(np.float32))
+    sg = jnp.sum(hist_b[..., 0], axis=(1, 2)) / F
+    sh = jnp.sum(hist_b[..., 1], axis=(1, 2)) / F
+    cnt = jnp.full((SPLITK,), np.float32(N))
+    minc = jnp.full((SPLITK,), np.float32(-1e30))
+    maxc = jnp.full((SPLITK,), np.float32(1e30))
+    vf = jax.vmap(lambda h, g, hh, c, lo, hi:
+                  finder(h, g, hh, c, lo, hi)["gain"])
+
+    def mk_split(k):
+        @jax.jit
+        def f(h):
+            def body(i, carry):
+                h, acc = carry
+                gain = vf(h, sg, sh, cnt, minc, maxc)
+                return (h + 1e-6, acc + gain[0, 0])
+            return lax.fori_loop(0, k, body, (h, jnp.float32(0.0)))
+        return f
+
+    try:
+        per = dev_time(mk_split, hist_b)
+        out["terms_ms"]["split_eval"] = round(per * 1e3, 2)
+        log(f"# split_eval[{SPLITK}]: {per * 1e3:.1f}ms")
+    except Exception as e:
+        log(f"# split_eval FAILED {type(e).__name__} {str(e)[:200]}")
+        out["terms_ms"]["split_eval"] = None
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

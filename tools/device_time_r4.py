@@ -49,7 +49,7 @@ def dev_time(mk_fn, *args):
 
 def main():
     from lightgbm_tpu.ops.aligned import move_pass, pack_records, \
-        slot_hist_pass
+        pack_route2, slot_hist_pass
 
     rng = np.random.RandomState(3)
     bins = rng.randint(0, MB, (N, F)).astype(np.uint8)
@@ -68,7 +68,7 @@ def main():
         meta_cnt = np.zeros(NC, np.int32)
         meta_cnt[:nc_data] = cnts
         iota = np.arange(NC, dtype=np.int32)
-        r2 = np.zeros(NC, np.int32) | (B << 16)
+        r2 = np.full(NC, pack_route2(0, B), np.int32)
         wsel = np.zeros(NC, np.int32)
         nohist = np.full(NC, S + 1, np.int32)
 

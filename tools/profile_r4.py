@@ -99,7 +99,8 @@ def main():
         return
 
     # ---- standalone pass benches on the engine's real state
-    from lightgbm_tpu.ops.aligned import move_pass, slot_hist_pass
+    from lightgbm_tpu.ops.aligned import move_pass, pack_route2, \
+        slot_hist_pass
     lr = gb.learner
     C, W, wcnt = eng.C, eng.W, eng.wcnt
     NC, S = eng.NC, eng.S
@@ -127,7 +128,7 @@ def main():
     meta = meta_cnt.copy()
     meta[0] |= 1 << 20
     meta[nc_data - 1] |= 1 << 21
-    r2 = np.zeros(NC, np.int32) | (MB << 16)
+    r2 = np.full(NC, pack_route2(0, B), np.int32)
     basel = np.zeros(NC, np.int32)
     baser = np.full(NC, nc_data // 2, np.int32)
     wsel = np.zeros(NC, np.int32)

@@ -34,7 +34,8 @@ def timeit(fn, reps=4):
 
 
 def main():
-    from lightgbm_tpu.ops.aligned import move_pass, pack_records, slot_hist_pass
+    from lightgbm_tpu.ops.aligned import move_pass, pack_records, \
+        pack_route2, slot_hist_pass
 
     rng = np.random.RandomState(3)
     bins = rng.randint(0, MB, (N, F)).astype(np.uint8)
@@ -59,7 +60,7 @@ def main():
         meta = meta_cnt.copy()
         meta[0] |= 1 << 20
         meta[nc_data - 1] |= 1 << 21
-        r2 = np.zeros(NC, np.int32) | (B << 16)
+        r2 = np.full(NC, pack_route2(0, B), np.int32)
         basel = np.zeros(NC, np.int32)
         baser = np.full(NC, nc_data // 2, np.int32)
         wsel = np.zeros(NC, np.int32)
