@@ -48,10 +48,22 @@ its host-fallback count ("fallbacks_255bin" / "mslr_fallbacks"), and
 whether the slot-hist store spilled to HBM through the DMA ring
 ("hist_spill_255bin" / "mslr_hist_spill").
 
+Crash-proofing (obs/bench_record.py): the cumulative record exists from
+second zero and every stage completion re-emits it AND atomically
+rewrites the BENCH_OUT sidecar file (default ./BENCH_partial.json, tmp +
+rename). SIGTERM/SIGINT traps and an exit hook flush one final record
+with "incomplete": true plus "stage_reached"/"stages_done", so a driver
+timeout (rc=124, SIGTERM-then-SIGKILL) can never again produce
+parsed: null. A completed run's final line carries "incomplete": false —
+every pre-existing key is unchanged, so BENCH_r01–r05 parsers keep
+working.
+
 Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
-BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1.
+BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1,
+BENCH_OUT=<path> (sidecar record), BENCH_TRACE=1 + BENCH_TRACE_DIR
+(obs span tracer + per-stage ledger records).
 LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
 persistent-cache location (default: ./.jax_cache).
 """
@@ -80,6 +92,7 @@ os.environ.setdefault("LGBT_COMPILE_CACHE_DIR", _cache)
 
 import lightgbm_tpu as lgb  # noqa: E402
 from lightgbm_tpu import compile_cache  # noqa: E402
+from lightgbm_tpu.obs.bench_record import BenchRecorder  # noqa: E402
 
 BASELINE_S = 238.5       # docs/Experiments.rst:106 (CPU, 16 threads)
 BASELINE_MSLR_S = 215.3  # docs/Experiments.rst:110
@@ -87,6 +100,8 @@ BASELINE_ITERS = 500
 
 _T0 = time.perf_counter()
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+_REC = None       # BenchRecorder owning the cumulative record (main only)
+_LEDGER = None    # optional obs RoundLedger for per-stage records
 
 
 def log(msg):
@@ -95,8 +110,31 @@ def log(msg):
 
 def emit(out):
     """Print the cumulative summary line NOW: a budget kill or crash later
-    still leaves every stage that finished on stdout."""
-    print(json.dumps(out), flush=True)
+    still leaves every stage that finished on stdout. When the recorder
+    owns `out` (main run), the same flush atomically rewrites the
+    BENCH_OUT sidecar file — a SIGKILL between stages loses nothing."""
+    if _REC is not None and _REC.out is out:
+        _REC.emit()
+    else:
+        print(json.dumps(out), flush=True)
+
+
+def _stage(name):
+    """Mark a stage as reached (the interruption record names it)."""
+    if _REC is not None:
+        _REC.start_stage(name)
+
+
+def _stage_done(name, out):
+    """Stage completed: re-emit the cumulative record, flush the sidecar,
+    and append a stage record to the obs ledger when one is attached."""
+    if _REC is not None:
+        _REC.stage_done(name)
+    else:
+        emit(out)
+    if _LEDGER is not None:
+        _LEDGER.commit({"kind": "note", "stage": name,
+                        "t_s": round(time.perf_counter() - _T0, 1)})
 
 
 def budget_left():
@@ -493,6 +531,7 @@ def main() -> None:
     if os.environ.get("BENCH_WARMRERUN_CHILD") == "1":
         warm_rerun_child()
         return
+    global _REC, _LEDGER
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_ROWS", 20_000 if smoke else 10_500_000))
     f = int(os.environ.get("BENCH_FEATURES", 28))
@@ -502,6 +541,23 @@ def main() -> None:
     n_hold = 4_000 if smoke else 500_000
     entries_before = compile_cache.cache_dir_entries(
         os.environ.get("LGBT_COMPILE_CACHE_DIR"))
+
+    # the cumulative record exists from second zero: a kill at ANY later
+    # point — data gen, first compile, mid-stage — leaves a parseable
+    # record on stdout and in the BENCH_OUT sidecar with incomplete:true
+    # and the stage reached (round-5's rc=124/parsed:null failure mode)
+    out = {"metric": "higgs_synth_500iter_s", "value": None, "unit": "s"}
+    _REC = BenchRecorder(out, path=os.environ.get("BENCH_OUT",
+                                                  "BENCH_partial.json"))
+    if os.environ.get("BENCH_TRACE") == "1":
+        from lightgbm_tpu.obs import ledger as obs_ledger
+        from lightgbm_tpu.obs import trace as obs_trace
+        tdir = os.environ.get("BENCH_TRACE_DIR", "lgbt_trace")
+        obs_trace.enable(tdir)
+        _LEDGER = obs_ledger.RoundLedger(
+            os.path.join(tdir, f"bench-{os.getpid()}.jsonl"),
+            {"bench": "bench.py", "smoke": smoke})
+    _stage("datagen")
 
     t0 = time.perf_counter()
     Xall, yall = synth_higgs(n + n_hold, f)
@@ -515,6 +571,7 @@ def main() -> None:
     # tools/ref_full_headtohead.py caches the reference binary's AUCs on
     # this exact data (the 1-core host makes the ref run an hours-long
     # out-of-band job); ours compute live here
+    _stage("higgs63")
     full = 0 if (smoke or os.environ.get("BENCH_SKIP_FULLAUC") == "1") \
         else BASELINE_ITERS
     projected, auc, done63, stats63 = run_higgs(n, f, leaves, iters, warmup,
@@ -522,10 +579,8 @@ def main() -> None:
                                                 full_iters=full)
     cache_dir = compile_cache.persistent_cache_dir()
     entries_after = compile_cache.cache_dir_entries(cache_dir)
-    out = {
-        "metric": "higgs_synth_500iter_s",
+    out.update({
         "value": round(projected, 2),
-        "unit": "s",
         "vs_baseline": round(BASELINE_S / projected, 3),
         "auc": round(auc, 6) if auc is not None else None,
         "warmup_s": stats63["warmup_s"],
@@ -539,7 +594,7 @@ def main() -> None:
             "entries_before": entries_before,
             "entries_after": entries_after,
         },
-    }
+    })
     if full:
         out["auc_ours_full_63bin"] = out["auc"]
         if done63 < full:
@@ -554,12 +609,13 @@ def main() -> None:
                     out[k] = rc[k]
         except Exception:
             pass
-    emit(out)
+    _stage_done("higgs63", out)
 
     # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table;
     # runs BEFORE the warm rerun / parity extras — it is the headline
     # gap this repo is closing, so a budget kill must not eat it) -------
     if stage_gate(out, "255bin", "BENCH_SKIP_255"):
+        _stage("255bin")
         projected255, auc255, done255, stats255 = run_higgs(
             n, f, leaves, max(iters // 2, 2), warmup, 255,
             hX if full else None, hy if full else None, X, y,
@@ -574,11 +630,12 @@ def main() -> None:
             out["auc_ours_full_255bin"] = round(auc255, 6)
             if done255 < full:
                 out["full_iters_done_255bin"] = done255
-        emit(out)
+        _stage_done("255bin", out)
 
     # ---- stage 3: MSLR lambdarank (second headline experiment; 255-bin
     # x F=137 — the aligned-path spill-ring shape) -----------------------
     if stage_gate(out, "mslr", "BENCH_SKIP_RANK"):
+        _stage("mslr")
         nm = 30_000 if smoke else 2_270_000
         fm = 20 if smoke else 137
         rit = 4 if smoke else 25
@@ -590,10 +647,11 @@ def main() -> None:
         out["mslr_aligned"] = minfo["aligned"]
         out["mslr_fallbacks"] = minfo["fallbacks"]
         out["mslr_hist_spill"] = minfo["hist_spill"]
-        emit(out)
+        _stage_done("mslr", out)
 
     # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --
     if stage_gate(out, "predict", "BENCH_SKIP_PREDICT"):
+        _stage("predict")
         try:
             from tools.bench_predict import run as bench_predict_run
             pred = bench_predict_run(
@@ -605,35 +663,41 @@ def main() -> None:
                 out[k] = pred[k]
         except Exception as e:   # the summary line must still print
             log(f"# predict stage FAILED: {type(e).__name__}: {e}")
-        emit(out)
+        _stage_done("predict", out)
 
     # ---- stage 5: valid-set overhead (diagnostic) ----------------------
     if stage_gate(out, "valid_overhead", "BENCH_SKIP_VALID"):
+        _stage("valid_overhead")
         vo_iters = 3 if smoke else 10
         per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
                                        leaves, vo_iters, 2)
         base_per = projected / BASELINE_ITERS
         out["valid_overhead_pct"] = round(
             (per_valid / base_per - 1.0) * 100.0, 1)
-        emit(out)
+        _stage_done("valid_overhead", out)
 
     # ---- stage 6: fresh-process warm rerun (certifies the persistent
     # cache: the child re-pays binning but should load, not compile) ----
     if stage_gate(out, "warm_rerun", "BENCH_SKIP_WARM"):
+        _stage("warm_rerun")
         run_warm_rerun(out)
-        emit(out)
+        _stage_done("warm_rerun", out)
 
     # ---- stage 7: reference-binary parity (slowest, least perishable) --
     if smoke:
         out.setdefault("stage_skips", {})["ref_parity"] = "BENCH_SMOKE=1"
     elif stage_gate(out, "ref_parity", "BENCH_SKIP_REF"):
+        _stage("ref_parity")
         auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
         if auc_ref is not None:
             out["auc_ours_1m_100it"] = round(auc_ours_1m, 6)
             out["auc_ref"] = round(auc_ref, 6)
+        _stage_done("ref_parity", out)
 
     out["wall_s"] = round(time.perf_counter() - _T0, 1)
-    emit(out)
+    _REC.finalize()
+    if _LEDGER is not None:
+        _LEDGER.close()
 
 
 if __name__ == "__main__":

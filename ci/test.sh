@@ -20,6 +20,13 @@ if [ "$MODE" = "install" ] || [ "$MODE" = "full" ]; then
     TGT="$(mktemp -d)"
     # --no-build-isolation: CI images are airgapped; setuptools is baked in
     pip install -q . --target "$TGT" --no-deps --no-build-isolation
+    # the build hook must stage native sources into build_lib only — an
+    # in-tree lightgbm_tpu/_native_src/ means staging leaked into the
+    # checkout (regression guard for the setup.py staging path)
+    if [ -e lightgbm_tpu/_native_src ]; then
+        echo "FAIL: pip install staged lightgbm_tpu/_native_src in-tree" >&2
+        exit 1
+    fi
     PKGTEST_TARGET="$TGT" python - <<'EOF'
 import os
 import sys
@@ -42,6 +49,41 @@ assert native.native_available(), "installed package lost native helpers"
 print("install smoke test: ok")
 EOF
     rm -rf "$TGT"
+fi
+
+echo "== telemetry smoke (5 traced rounds -> schema-validated ledger) =="
+TRACE_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_trace"
+LGBT_SMOKE_TRACE_DIR="$TRACE_DIR" python - <<'EOF'
+import glob
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import ledger as obs_ledger
+
+tdir = os.environ["LGBT_SMOKE_TRACE_DIR"]
+rng = np.random.RandomState(7)
+X = rng.rand(600, 8)
+y = (X[:, 0] + 0.3 * rng.randn(600) > 0.5).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                 "tpu_trace": True, "tpu_trace_dir": tdir},
+                lgb.Dataset(X, label=y), num_boost_round=5)
+paths = sorted(glob.glob(os.path.join(tdir, "ledger-*.jsonl")))
+assert paths, f"no ledger written under {tdir}"
+recs = obs_ledger.read_ledger(paths[-1])
+for rec in recs:
+    obs_ledger.validate_record(rec)
+rounds = [r for r in recs if r["kind"] == "round"]
+assert [r["round"] for r in rounds] == list(range(5)), rounds
+assert recs[0]["kind"] == "run" and "config_sig" in recs[0], recs[0]
+print(f"telemetry smoke: ok ({len(recs)} records, 5 rounds, "
+      f"ledger at {paths[-1]})")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "telemetry ledger kept under $TRACE_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$TRACE_DIR")"
 fi
 
 echo "== tests ($MODE tier) =="

@@ -7,8 +7,8 @@ finding is a vectorized scan over bins, and the distributed tree learners run
 XLA collectives over a `jax.sharding.Mesh`.
 """
 from .basic import Booster, Dataset, LightGBMError
-from .callback import (EarlyStopException, early_stopping, print_evaluation,
-                       record_evaluation, reset_parameter)
+from .callback import (EarlyStopException, early_stopping, log_telemetry,
+                       print_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
 
@@ -18,7 +18,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError",
     "train", "cv",
     "early_stopping", "print_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "log_telemetry", "EarlyStopException",
 ]
 
 try:  # sklearn API is optional (mirrors the reference's compat gating)

@@ -357,10 +357,14 @@ class Booster:
         self.best_score: Dict = {}
         self._flat_cache: Optional[tuple] = None
         self._engine_cache: Dict[tuple, Any] = {}
+        self._predict_engine_calls = 0
+        self._predict_fallback_calls = 0
+        self._predict_route_last: Optional[bool] = None
         self._model_gen = 0
         self.pandas_categorical = None
         self._train_set = train_set
         self._gbdt: Optional[GBDT] = None
+        self._telemetry = None  # engine.train parks the ledger here
         self._loaded: Optional[Dict] = None
         self._name_valid_sets: List[str] = []
         self._valid_sets_public: List["Dataset"] = []
@@ -414,6 +418,12 @@ class Booster:
         if self._gbdt is not None:
             return self._gbdt.num_tree_per_iteration
         return self._loaded.get("num_tree_per_iteration", 1)
+
+    @property
+    def telemetry(self):
+        """The training RoundLedger (obs/ledger.py) when `tpu_trace` is
+        on; None otherwise."""
+        return getattr(self._gbdt, "telemetry", None) or self._telemetry
 
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -666,6 +676,20 @@ class Booster:
                 and (jax.default_backend() != "cpu"
                      or (not native_available()
                          and n * len(trees) >= (1 << 18)))))
+        # serve-engine routing counters on the structured channel: one
+        # event per ROUTE CHANGE (not per call), so scoring loops stay
+        # quiet while a silent fall-off-the-engine is still visible
+        if use_engine:
+            self._predict_engine_calls += 1
+        else:
+            self._predict_fallback_calls += 1
+        if use_engine != self._predict_route_last:
+            self._predict_route_last = use_engine
+            from .utils import log
+            log.event("predict_route", engine=bool(use_engine),
+                      policy=pd,
+                      engine_calls=self._predict_engine_calls,
+                      fallback_calls=self._predict_fallback_calls)
         if use_engine:
             eng = self._serve_engine(trees, s_iter, u_spec)
             if bool(opts.get("predict_sharded", False)) and not pred_leaf:

@@ -10,7 +10,11 @@ from typing import Callable, Dict, List
 CallbackEnv = collections.namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+     "evaluation_result_list", "telemetry"])
+# `telemetry` (an obs.ledger.RoundLedger, or None when tpu_trace is off)
+# defaults so third-party construction of the older 6-field env keeps
+# working
+CallbackEnv.__new__.__defaults__ = (None,)
 
 
 class EarlyStopException(Exception):
@@ -60,6 +64,31 @@ def record_evaluation(eval_result: Dict) -> Callable:
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
     _callback.order = 20
+    return _callback
+
+
+def log_telemetry(period: int = 1) -> Callable:
+    """Fold per-round eval metric values into the telemetry ledger and
+    re-emit the round record on the structured log channel every
+    `period` iterations (0: ledger-fold only, no events). A no-op
+    unless training runs with `tpu_trace` — the ledger rides in
+    ``env.telemetry`` (or on the booster for externally-built envs)."""
+    def _callback(env: CallbackEnv) -> None:
+        led = env.telemetry
+        if led is None:
+            led = getattr(getattr(env.model, "_gbdt", None),
+                          "telemetry", None)
+        if led is None:
+            return
+        if env.evaluation_result_list:
+            led.record_eval(env.iteration, env.evaluation_result_list)
+        if period > 0 and (env.iteration + 1) % period == 0:
+            rec = led.last_round()
+            if rec is not None:
+                from .utils import log
+                log.event("telemetry", **{k: v for k, v in rec.items()
+                                          if k != "kind"})
+    _callback.order = 25
     return _callback
 
 

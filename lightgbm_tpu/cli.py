@@ -126,6 +126,12 @@ class Application:
         if cfg.snapshot_freq > 0 and cfg.output_model:
             callbacks.append(_snapshot_callback(cfg.output_model,
                                                 cfg.snapshot_freq))
+        if cfg.tpu_trace:
+            # CLI traced runs re-emit each round record on the
+            # structured channel at metric frequency (snapshot-style:
+            # progress is observable mid-run, not only at the end)
+            from .callback import log_telemetry
+            callbacks.append(log_telemetry(period=max(1, cfg.metric_freq)))
         booster = engine_train(
             dict(self.raw_params), train_set,
             num_boost_round=cfg.num_iterations,
@@ -135,6 +141,12 @@ class Application:
             callbacks=callbacks)
         out = cfg.output_model or "LightGBM_model.txt"
         booster.save_model(out)
+        if cfg.tpu_trace:
+            from .obs import trace as obs_trace
+            tdir = cfg.tpu_trace_dir or "lgbt_trace"
+            dump = obs_trace.write(os.path.join(tdir,
+                                                "trace_summary.json"))
+            print(f"Telemetry: span summary at {dump}")
         print(f"Finished training. Model saved to {out}")
 
     # ------------------------------------------------------------------

@@ -103,6 +103,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if learning_rates is not None:
         callbacks.append(callback_mod.reset_parameter(
             learning_rate=learning_rates))
+    telemetry = getattr(getattr(booster, "_gbdt", None), "telemetry",
+                        None)
+    if telemetry is not None and not any(
+            getattr(cb, "order", 0) == 25 for cb in callbacks):
+        # tpu_trace runs fold eval values into the ledger automatically
+        callbacks.append(callback_mod.log_telemetry(period=0))
     callbacks_before = [cb for cb in callbacks
                         if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks if cb not in callbacks_before]
@@ -115,7 +121,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
                 begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
+                evaluation_result_list=None, telemetry=telemetry))
         booster.update(fobj=fobj)
 
         evaluation_result_list = []
@@ -134,7 +140,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
+                    evaluation_result_list=evaluation_result_list,
+                    telemetry=telemetry))
         except EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
@@ -148,6 +155,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         fresh.best_iteration = booster.best_iteration
         fresh.best_score = booster.best_score
         fresh.params = params
+        # the round ledger lives on the training GBDT, which this fresh
+        # booster no longer holds — carry the handle so bst.telemetry
+        # still resolves after train() returns
+        fresh._telemetry = telemetry
         return fresh
     return booster
 

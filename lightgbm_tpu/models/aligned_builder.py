@@ -1181,23 +1181,29 @@ class AlignedEngine:
         dispatch following an inexact predecessor is a guaranteed no-op
         and will be discarded by the host). `grads` = (g_rows, h_rows)
         device arrays for non-pointwise objectives."""
+        from ..obs import trace as obs_trace
         fmask = self.learner._fmask_arr(feature_mask)
-        if grads is not None:
-            fn = self._program(
-                "build_ext",
-                lambda: self._build_program(external_grads=True),
-                donate=(0, 1), specs=self._specs("build_ext")
-                if self.axis else None)
-            rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
-                self.rec, self.cnts, fmask, jnp.float32(scale),
-                self._last_exact, grads[0], grads[1])
-        else:
-            fn = self._program("build", self._build_program,
-                               donate=(0, 1), specs=self._specs("build")
-                               if self.axis else None)
-            rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
-                self.rec, self.cnts, fmask, jnp.float32(scale),
-                self._last_exact)
+        # host-side dispatch span only — this boundary must stay free of
+        # device syncs (the round loop pipelines on it), so the tracer
+        # observes dispatch latency here and device drain at the round
+        # fence in gbdt._train_one_iter_traced
+        with obs_trace.span("aligned.dispatch", iter=self._iter_tag):
+            if grads is not None:
+                fn = self._program(
+                    "build_ext",
+                    lambda: self._build_program(external_grads=True),
+                    donate=(0, 1), specs=self._specs("build_ext")
+                    if self.axis else None)
+                rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
+                    self.rec, self.cnts, fmask, jnp.float32(scale),
+                    self._last_exact, grads[0], grads[1])
+            else:
+                fn = self._program("build", self._build_program,
+                                   donate=(0, 1), specs=self._specs("build")
+                                   if self.axis else None)
+                rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
+                    self.rec, self.cnts, fmask, jnp.float32(scale),
+                    self._last_exact)
         self._last_exact = exact_dev
         # records AND per-chunk counts were donated (in-place round
         # loop): the physical layout advances either
@@ -1229,6 +1235,7 @@ class AlignedEngine:
         pre-iteration scores. Returns (spec, ncommit_dev, exact_dev,
         applied_dev) — all device values, no sync; `applied_dev` is the
         chain gate under which this spec's values will apply."""
+        from ..obs import trace as obs_trace
         fmask = self.learner._fmask_arr(feature_mask)
         fn = self._program(
             ("build_mc", class_k),
@@ -1239,9 +1246,12 @@ class AlignedEngine:
             pspec, _pk, psc = self._mc_pending
             pleafI, pcover, pn_exec, pscale = (
                 pspec.leafI, pspec.cover, pspec.n_exec, jnp.float32(psc))
-        rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
-            self.rec, self.cnts, fmask, jnp.float32(scale), self._gate,
-            pleafI=pleafI, pcover=pcover, pn_exec=pn_exec, pscale=pscale)
+        # dispatch-only span (no sync — the mc chain pipelines too)
+        with obs_trace.span("aligned.dispatch_mc", class_k=class_k,
+                            iter=self._iter_tag):
+            rec, cnts, spec, exact_dev, ncommit_dev, applied_dev = fn(
+                self.rec, self.cnts, fmask, jnp.float32(scale), self._gate,
+                pleafI=pleafI, pcover=pcover, pn_exec=pn_exec, pscale=pscale)
         self.rec, self.cnts = rec, cnts
         self._gate = applied_dev          # chain: g & exact
         self._mc_pending = (spec, class_k, scale)

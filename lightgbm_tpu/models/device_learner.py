@@ -1313,6 +1313,7 @@ class DeviceTreeLearner:
         """Grow one tree on an explicit (e.g. bagged) partition; returns
         (new partition indices, TreeRecord). `indices` must be padded so
         begin+bucket_size never overflows (length n + pow2ceil(n))."""
+        from ..obs import trace as obs_trace
         root_padded = max(_pow2ceil(root_count), self.min_pad)
         fn = self._cached_program(
             (root_padded, False),
@@ -1321,7 +1322,8 @@ class DeviceTreeLearner:
                 jnp.int32(root_count), self._fmask_arr(feature_mask)]
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
-        idxs, rec = fn(*args)
+        with obs_trace.span("learner.train", root=root_padded):
+            idxs, rec = fn(*args)
         self._cegb_note_record(rec) if self._cegb_coupled_on else None
         return idxs, rec
 
@@ -1335,6 +1337,7 @@ class DeviceTreeLearner:
             out = self._level_train_fresh(grad, hess, feature_mask)
             if out is not None:
                 return out
+        from ..obs import trace as obs_trace
         root_padded = max(_pow2ceil(self.n), self.min_pad)
         fn = self._cached_program(
             (root_padded, True),
@@ -1343,7 +1346,8 @@ class DeviceTreeLearner:
                 self._fmask_arr(feature_mask)]
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
-        idxs, rec = fn(*args)
+        with obs_trace.span("learner.train_fresh", root=root_padded):
+            idxs, rec = fn(*args)
         if self._cegb_coupled_on:
             self._cegb_note_record(rec)
         return idxs, rec

@@ -216,6 +216,16 @@ class GBDT:
                 * self.num_tree_per_iteration
         self._pending_numsplits: List[jax.Array] = []
         self._valid_bins_dev: List[jax.Array] = []
+        # telemetry (obs/): None when off — the round loop's ONLY added
+        # cost on the default path is this attribute check
+        self.telemetry = None
+        self._obs_fallbacks_seen = 0
+        if cfg.tpu_trace:
+            from ..obs import ledger as obs_ledger
+            from ..obs import trace as obs_trace
+            tdir = cfg.tpu_trace_dir or "lgbt_trace"
+            obs_trace.enable(tdir)
+            self.telemetry = obs_ledger.RoundLedger.for_training(tdir, cfg)
 
     @staticmethod
     def _reshape_init_score(ds: Dataset) -> Optional[np.ndarray]:
@@ -316,7 +326,71 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> bool:
         """reference GBDT::TrainOneIter (gbdt.cpp:367-448). Returns True when
         training should STOP (no splittable tree), mirroring the C API's
-        is_finished flag."""
+        is_finished flag. With `tpu_trace` on, every round commits one
+        ledger record (see _train_one_iter_traced); off, this is a
+        single None check."""
+        if self.telemetry is None:
+            return self._train_one_iter_impl(grad, hess)
+        return self._train_one_iter_traced(grad, hess)
+
+    def _round_fence_target(self):
+        """What to drain to observe this round's device time: the
+        aligned engine's newest pending dispatch when the pipelined path
+        is active (train_score is synced lazily there and would fence
+        stale work), the score buffer otherwise."""
+        pend = getattr(self, "_aligned_pending", None) or []
+        if pend:
+            return pend[-1]
+        pend_mc = getattr(self, "_aligned_pending_mc", None)
+        if pend_mc is not None:
+            return pend_mc[0]
+        return self.train_score.score
+
+    def _train_one_iter_traced(self, grad, hess) -> bool:
+        """One traced round: StepTraceAnnotation + span around the
+        untouched implementation, ONE fence to split wall time into the
+        host-visible part and the residual device drain, then a ledger
+        commit. This path only runs when cfg.tpu_trace is set."""
+        import time as _time
+
+        from ..compile_cache import trace_count
+        from ..obs import trace as obs_trace
+        rnd = self.iter
+        traces0 = trace_count()
+        t0 = _time.perf_counter()
+        with obs_trace.step(rnd):
+            with obs_trace.span("train.round", round=rnd):
+                finished = self._train_one_iter_impl(grad, hess)
+                t_host = _time.perf_counter()
+                with obs_trace.span("train.round.fence", round=rnd):
+                    obs_trace.fence(self._round_fence_target())
+        t1 = _time.perf_counter()
+        eng = getattr(self, "_aligned_eng_ref", None)
+        fb = int(getattr(eng, "fallbacks", 0) or 0) if eng is not None \
+            else 0
+        path = getattr(self, "_iter_path", "unknown")
+        rec = {
+            "kind": "round", "round": rnd,
+            "wall_ms": round((t1 - t0) * 1e3, 3),
+            "device_ms": round((t1 - t_host) * 1e3, 3),
+            "traces": trace_count() - traces0,
+            "path": path,
+            "aligned": path.startswith("aligned"),
+            "fallbacks": fb - self._obs_fallbacks_seen,
+            "trees": len(self.models),
+            "bag_cnt": int(self.bag_data_cnt),
+            "finished": bool(finished),
+        }
+        self._obs_fallbacks_seen = fb
+        notes = list(getattr(self, "_gate_notes", ()) or ())
+        if notes:
+            rec["gate_notes"] = notes
+            rec["hist_spill"] = any("spill" in n.lower() for n in notes)
+        self.telemetry.commit(rec)
+        return finished
+
+    def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None,
+                             hess: Optional[np.ndarray] = None) -> bool:
         cfg = self.cfg
         init_scores = [0.0] * self.num_tree_per_iteration
         if grad is None or hess is None:
@@ -385,11 +459,14 @@ class GBDT:
         (VERDICT r5 #8). When the aligned engine was NOT chosen, name the
         first failing gate so a mis-routed run is diagnosable from the
         log alone."""
+        self._iter_path = path          # per-round, telemetry reads it
         if getattr(self, "_path_logged", False):
             return
         self._path_logged = True
         from ..utils import log
         msg = f"training path: {path}"
+        notes: List[str] = []
+        why = None
         if path.startswith("aligned"):
             # info gate-notes: the path IS aligned, but e.g. the
             # slot-hist store spilled to HBM — a different perf regime
@@ -398,11 +475,11 @@ class GBDT:
             if gate_notes is not None:
                 try:
                     for note in gate_notes():
+                        notes.append(str(note))
                         msg += f" ({note})"
                 except Exception:
                     pass
         if not path.startswith("aligned"):
-            why = None
             gate = getattr(self.learner, "aligned_mode_gate", None)
             if gate is not None:
                 try:
@@ -414,7 +491,18 @@ class GBDT:
                         "renew-output objective, or multi-tree class gating)"
             if why is not None:
                 msg += f" (aligned engine rejected: {why})"
+        self._gate_notes = notes
         log.info(msg)
+        log.event("train_path", path=path, gate_notes=notes,
+                  rejected=why)
+
+    def _note_aligned_fallback(self, eng, why: str) -> None:
+        """Count an aligned exact-replay fallback on the engine and
+        surface it on the structured channel; the ledger folds the
+        counter delta into the next round record."""
+        from ..utils import log
+        eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+        log.event("aligned_fallback", count=int(eng.fallbacks), why=why)
 
     def _append_constant_tree(self, k: int, init_scores) -> Tree:
         """Constant tree carrying the init score (gbdt.cpp:413-433): only the
@@ -596,7 +684,7 @@ class GBDT:
          bag_idx, bag_cnt, j) = info
         K = self.num_tree_per_iteration
         eng = self._aligned_eng_ref
-        eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+        self._note_aligned_fallback(eng, "multiclass inexact replay")
         self._valid_eval_stash = None
         self._train_eval_stash = None
         scores = eng.row_scores_mc_dev()               # [K, N], no pull
@@ -689,7 +777,8 @@ class GBDT:
                 # the same (failed) tree on unchanged scores — discard
                 # it, grow the failed tree exactly, then dispatch this
                 # iteration fresh
-                eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+                self._note_aligned_fallback(
+                    eng, "speculative successor discarded")
                 stop = self._aligned_fallback_iter(redo[1], eng, redo[2],
                                                    redo[3], redo[4])
                 if stop:
@@ -868,7 +957,7 @@ class GBDT:
         if not final and j == len(q) - 1:
             return ("redo",) + tuple(q[j][1:])
         eng = self._aligned_eng_ref
-        eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+        self._note_aligned_fallback(eng, "inexact replay in pending batch")
         stop = self._aligned_fallback_iter(q[j][1], eng, q[j][2],
                                            q[j][3], q[j][4])
         for (_e, init_r, fmask_r, _bi, _bc) in q[j + 1:]:
@@ -888,7 +977,7 @@ class GBDT:
         spec, ncommit_dev, exact_dev, _applied = \
             self._dispatch_aligned(eng, fmask)
         if not bool(exact_dev):
-            eng.fallbacks = getattr(eng, "fallbacks", 0) + 1
+            self._note_aligned_fallback(eng, "inexact replay")
             return self._aligned_fallback_iter(init_scores, eng, fmask)
         self._train_score_stale = True
         lazy = LazyAlignedTree(spec, self.shrinkage_rate, init_scores[0],

@@ -1,0 +1,13 @@
+"""Observability: span tracer, per-round metrics ledger, device-time
+measurement protocol, and crash-proof incremental bench records.
+
+The subsystem is OFF by default and costs nothing when off: `trace.span`
+returns a shared null context, `trace.fence` returns its argument without
+importing jax, and the GBDT round loop takes a single attribute-is-None
+branch. Enable with the `tpu_trace` / `tpu_trace_dir` params (both enter
+`compile_cache.config_signature`, so toggling tracing retraces rather
+than silently reusing a differently-fenced program).
+"""
+from . import bench_record, devicetime, ledger, trace  # noqa: F401
+
+__all__ = ["bench_record", "devicetime", "ledger", "trace"]

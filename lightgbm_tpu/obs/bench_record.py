@@ -1,0 +1,118 @@
+"""Crash-proof incremental benchmark records.
+
+The round-5 bench regression (`BENCH_r05.json: rc 124, parsed: null`)
+happened because bench.py printed its JSON summary only as the very last
+line — a driver timeout voided the whole record. `BenchRecorder` makes
+that impossible:
+
+- the cumulative record is RE-EMITTED to stdout after every completed
+  stage (the driver's "last JSON line wins" parse stays valid at any
+  kill point);
+- every flush also atomically rewrites a sidecar file (tmp + rename), so
+  partial results survive even a SIGKILL between stages;
+- SIGTERM/SIGINT traps and an atexit hook flush one final time with
+  ``incomplete: true`` plus the stage reached — `timeout -k` sends
+  SIGTERM first, which gives the trap a window before the follow-up
+  SIGKILL;
+- ``finalize()`` clears the incomplete marker and writes the same schema
+  as before (the new keys are additive, so BENCH_r01–r05 parsers keep
+  working).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+from typing import Any, Dict, Optional
+
+
+class BenchRecorder:
+    """Owns the cumulative bench `out` dict and its durability."""
+
+    def __init__(self, out: Dict[str, Any], path: Optional[str] = None,
+                 install_traps: bool = True) -> None:
+        self.out = out
+        self.path = path
+        self.finalized = False
+        out.setdefault("incomplete", True)
+        out.setdefault("stage_reached", None)
+        out.setdefault("stages_done", [])
+        if install_traps:
+            self._install_traps()
+
+    # -- stage protocol ----------------------------------------------------
+    def start_stage(self, name: str) -> None:
+        self.out["stage_reached"] = name
+        # sidecar-only flush (no stdout line): even an untrappable
+        # SIGKILL mid-stage leaves the stage name on disk
+        self.flush_file()
+
+    def stage_done(self, name: str) -> None:
+        if name not in self.out["stages_done"]:
+            self.out["stages_done"].append(name)
+        self.emit()
+
+    # -- durability --------------------------------------------------------
+    def emit(self) -> None:
+        """Print the cumulative record as one stdout JSON line AND flush
+        the sidecar file. Call after every stage (and on any skip that
+        mutates the record) — the last line printed is always complete."""
+        print(json.dumps(self.out, default=str), flush=True)
+        self.flush_file()
+
+    def flush_file(self) -> None:
+        """Atomic tmp+rename rewrite of the sidecar (no-op without a
+        path). A reader never observes a torn file."""
+        if not self.path:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(self.out, fh, default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def finalize(self) -> Dict[str, Any]:
+        """Mark the run complete and emit the final record."""
+        self.finalized = True
+        self.out["incomplete"] = False
+        self.emit()
+        return self.out
+
+    # -- interruption ------------------------------------------------------
+    def flush_incomplete(self, reason: Optional[str] = None) -> None:
+        """One last durable emit with the incomplete marker set — the
+        SIGTERM/atexit path."""
+        if self.finalized:
+            return
+        self.out["incomplete"] = True
+        if reason:
+            self.out["interrupted_by"] = reason
+        self.emit()
+
+    def _install_traps(self) -> None:
+        atexit.register(self._atexit_flush)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):
+                pass  # non-main thread / restricted environment
+
+    def _atexit_flush(self) -> None:
+        if not self.finalized:
+            self.flush_incomplete("exit")
+
+    def _on_signal(self, signum, frame) -> None:
+        self.flush_incomplete(signal.Signals(signum).name)
+        self.finalized = True        # the atexit hook need not re-flush
+        sys.stdout.flush()
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)  # preserve the caller-visible rc

@@ -1,0 +1,147 @@
+"""Per-round metrics ledger: one JSONL record per boosting round,
+flushed as it happens so a killed run still leaves rounds 0..k on disk.
+
+Record kinds:
+
+- ``run``   — one header per ledger: schema version, pid, config digest.
+- ``round`` — one per boosting round. Required fields: ``round``,
+  ``wall_ms`` (fence-to-fence host wall time), ``device_ms`` (the
+  residual device drain after host dispatch returned — i.e. the time
+  spent blocked in the tracing fence), ``traces`` (new XLA traces this
+  round, from ``compile_cache.trace_count`` deltas), ``path`` (the
+  training path string from ``_log_train_path``), ``aligned`` bool,
+  ``fallbacks`` (aligned exact-replay fallbacks this round), ``trees``.
+  Optional: ``gate_notes`` (e.g. "slot-hist spilled to HBM"),
+  ``hist_spill`` bool, ``bag_cnt`` (bagging/GOSS sample size),
+  ``finished`` (no-split stop flag), ``eval`` (folded in by the
+  ``log_telemetry`` callback after metrics run).
+- ``eval``  — per-round metric values, appended by the callback seam
+  (the round record is already flushed by then; the eval record carries
+  the same ``round`` index so readers can join them).
+
+Readers: ``read_ledger(path)`` -> list of dicts; ``validate_record``
+raises on schema violations (used by tests and the CI telemetry smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+ROUND_REQUIRED = ("round", "wall_ms", "device_ms", "traces", "path",
+                  "aligned", "fallbacks", "trees")
+_KINDS = ("run", "round", "eval", "note")
+
+_seq = 0
+
+
+def validate_record(rec: Dict[str, Any]) -> None:
+    """Raise ValueError unless `rec` is a well-formed ledger record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"ledger record must be a dict, got {type(rec)}")
+    kind = rec.get("kind")
+    if kind not in _KINDS:
+        raise ValueError(f"ledger record kind {kind!r} not in {_KINDS}")
+    if kind == "round":
+        missing = [k for k in ROUND_REQUIRED if k not in rec]
+        if missing:
+            raise ValueError(f"round record missing fields: {missing}")
+        if not isinstance(rec["round"], int) or rec["round"] < 0:
+            raise ValueError(f"bad round index: {rec['round']!r}")
+        for k in ("wall_ms", "device_ms"):
+            if not isinstance(rec[k], (int, float)) or rec[k] < 0:
+                raise ValueError(f"bad {k}: {rec[k]!r}")
+        if not isinstance(rec["aligned"], bool):
+            raise ValueError(f"bad aligned flag: {rec['aligned']!r}")
+    if kind == "eval" and "round" not in rec:
+        raise ValueError("eval record missing round index")
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    """Parse a ledger JSONL file; raises on any malformed line."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class RoundLedger:
+    """Append-only JSONL metrics ledger with an in-memory mirror."""
+
+    def __init__(self, path: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        self.path = path
+        self.records: List[Dict[str, Any]] = []
+        self._fh = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a")
+        head = {"kind": "run", "schema": SCHEMA_VERSION, "pid": os.getpid()}
+        if meta:
+            head.update(meta)
+        self.commit(head)
+
+    @classmethod
+    def for_training(cls, trace_dir: str,
+                     cfg: Any = None) -> "RoundLedger":
+        """A training ledger at ``<dir>/ledger-<pid>-<seq>.jsonl`` with
+        a config-digest header (so a trace directory holding several
+        runs stays attributable)."""
+        global _seq
+        _seq += 1
+        path = os.path.join(trace_dir,
+                            f"ledger-{os.getpid()}-{_seq}.jsonl")
+        meta: Dict[str, Any] = {}
+        if cfg is not None:
+            try:
+                import hashlib
+
+                from ..compile_cache import config_signature
+                sig = json.dumps(config_signature(cfg), sort_keys=True,
+                                 default=str)
+                meta["config_sig"] = hashlib.sha1(
+                    sig.encode()).hexdigest()[:16]
+                meta["objective"] = cfg.objective
+            except Exception:
+                pass
+        return cls(path, meta)
+
+    def commit(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """Validate, mirror in memory, and flush one JSONL line."""
+        validate_record(rec)
+        self.records.append(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, sort_keys=True, default=str)
+                           + "\n")
+            self._fh.flush()
+        return rec
+
+    def record_eval(self, round_idx: int, results) -> None:
+        """Fold per-round metric values in via the callback seam:
+        annotate the in-memory round record AND append an `eval` line
+        (the round line is already durable by the time metrics run)."""
+        vals = {f"{dn}:{mn}": float(v) for dn, mn, v, _ in results}
+        for rec in reversed(self.records):
+            if rec.get("kind") == "round" and rec["round"] == round_idx:
+                rec["eval"] = vals
+                break
+        self.commit({"kind": "eval", "round": round_idx, "values": vals})
+
+    def last_round(self) -> Optional[Dict[str, Any]]:
+        for rec in reversed(self.records):
+            if rec.get("kind") == "round":
+                return rec
+        return None
+
+    def round_records(self) -> List[Dict[str, Any]]:
+        return [r for r in self.records if r.get("kind") == "round"]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

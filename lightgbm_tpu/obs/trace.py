@@ -1,0 +1,205 @@
+"""Low-overhead span tracer: nested host spans, device-time fences, and
+`jax.profiler` annotations, all gated on a single module-level flag.
+
+Design constraints (ISSUE 4):
+
+- Disabled cost is NIL. `span()` hands back one shared null context
+  (no allocation), `fence()` returns its argument untouched (jax is not
+  even imported), and callers guard everything else behind
+  ``trace.enabled()``.
+- Device time is only observable at a fence. ``fence(x)`` calls
+  ``jax.block_until_ready`` on the pytree ONLY while tracing is on and
+  counts every such call in ``fence_count`` — the tier-1 zero-fence test
+  monkeypatches ``_block`` with a counting wrapper and asserts it never
+  fires on an untraced run.
+- Spans also enter XLA profiles: each span wraps a
+  ``jax.profiler.TraceAnnotation`` and the round loop wraps each round
+  in ``jax.profiler.StepTraceAnnotation`` (via ``step()``), so attaching
+  the jax profiler to a traced run yields named regions for free.
+
+Completed spans accumulate in memory and — when a trace directory is
+configured — append to ``<dir>/spans-<pid>.jsonl`` one JSON record per
+span, flushed per line so a killed process keeps everything closed so
+far.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+fence_count = 0          # fences issued while tracing (test probe)
+_enabled = False
+_dir: Optional[str] = None
+_fh = None
+_spans: List[Dict[str, Any]] = []
+_depth = 0
+_lock = threading.Lock()
+_block = None            # resolved lazily to jax.block_until_ready
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(trace_dir: Optional[str] = None) -> None:
+    """Turn the tracer on, optionally appending span JSONL under
+    `trace_dir` (created if missing). Idempotent; a later call with a
+    directory upgrades a memory-only tracer to a file-backed one."""
+    global _enabled, _dir, _fh
+    with _lock:
+        _enabled = True
+        if trace_dir and trace_dir != _dir:
+            if _fh is not None:
+                _fh.close()
+            os.makedirs(trace_dir, exist_ok=True)
+            _dir = trace_dir
+            _fh = open(os.path.join(trace_dir,
+                                    f"spans-{os.getpid()}.jsonl"), "a")
+
+
+def disable() -> None:
+    global _enabled, _fh, _dir
+    with _lock:
+        _enabled = False
+        if _fh is not None:
+            _fh.close()
+            _fh = None
+        _dir = None
+
+
+def reset() -> None:
+    """Clear accumulated spans and the fence counter (tests)."""
+    global fence_count
+    with _lock:
+        _spans.clear()
+        fence_count = 0
+
+
+def spans() -> List[Dict[str, Any]]:
+    """Completed span records, in completion order."""
+    return list(_spans)
+
+
+def trace_dir() -> Optional[str]:
+    return _dir
+
+
+class _NullSpan:
+    """Shared do-nothing context for the disabled path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def _profiler_annotation(name: str):
+    """A jax.profiler.TraceAnnotation when the profiler is importable;
+    None otherwise (the tracer must not force a jax import ordering)."""
+    try:
+        from jax import profiler
+        return profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "_ann")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        global _depth
+        self._ann = _profiler_annotation(self.name)
+        if self._ann is not None:
+            self._ann.__enter__()
+        with _lock:
+            _depth += 1
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        global _depth
+        dur = time.perf_counter() - self.t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        rec = {"kind": "span", "name": self.name, "t0": self.t0,
+               "dur_ms": round(dur * 1e3, 4)}
+        if self.attrs:
+            rec.update(self.attrs)
+        with _lock:
+            _depth -= 1
+            rec["depth"] = _depth
+            _spans.append(rec)
+            if _fh is not None:
+                _fh.write(json.dumps(rec, sort_keys=True, default=str)
+                          + "\n")
+                _fh.flush()
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing a named region. Free when tracing is off
+    (returns one shared null context, no allocation)."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, attrs)
+
+
+def step(step_num: int):
+    """Round boundary: wraps `jax.profiler.StepTraceAnnotation` so XLA
+    profiles group work per boosting round. Null context when off."""
+    if not _enabled:
+        return _NULL
+    try:
+        from jax import profiler
+        return profiler.StepTraceAnnotation("train_round",
+                                            step_num=step_num)
+    except Exception:
+        return _NULL
+
+
+def fence(x):
+    """Drain device work hanging off pytree `x` — ONLY while tracing.
+
+    Disabled: returns `x` untouched without importing jax (this is the
+    round loop's guarantee of zero added fences). Enabled: blocks until
+    every jax array leaf is ready and bumps `fence_count`.
+    """
+    global fence_count, _block
+    if not _enabled:
+        return x
+    if _block is None:
+        import jax
+        _block = jax.block_until_ready
+    fence_count += 1
+    return _block(x)
+
+
+def write(path: str) -> str:
+    """Dump all completed spans (plus a summary header) to `path` as one
+    JSON document — the CLI's end-of-training trace dump."""
+    by_name: Dict[str, Dict[str, float]] = {}
+    for s in _spans:
+        agg = by_name.setdefault(s["name"], {"count": 0, "total_ms": 0.0})
+        agg["count"] += 1
+        agg["total_ms"] = round(agg["total_ms"] + s["dur_ms"], 4)
+    doc = {"pid": os.getpid(), "fences": fence_count,
+           "summary": by_name, "spans": _spans}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path

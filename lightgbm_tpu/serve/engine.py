@@ -199,6 +199,8 @@ class ForestEngine:
         self.min_bucket = int(min_bucket)
         self._chunk_rows_opt = chunk_rows
         self.compile_count = 0          # bumped at TRACE time only
+        self.cache_hits = 0             # chunk dispatches with no new trace
+        self.predict_calls = 0
         self._jit_run = jax.jit(self._run)
         self._jit_run_routed = jax.jit(self._run_routed)
         self._sharded_cache: dict = {}
@@ -477,25 +479,40 @@ class ForestEngine:
         leaves [N, T] int32 or None). Large batches stream through
         fixed-size chunks; small ones pad to a power-of-two bucket, so any
         N inside a bucket reuses the same compiled program."""
+        from ..obs import trace as obs_trace
+        from ..utils import log
         planes = self._encode(X)
         n = planes[0].shape[1]
         acc = np.empty((n, self.num_class), np.float64)
         leaves = np.empty((n, self.num_trees), np.int32) if pred_leaf \
             else None
         step = self.chunk_rows
-        for lo in range(0, max(n, 1), step):
-            hi = min(lo + step, n)
-            m = hi - lo
-            bucket = self._bucket(m)   # tail chunks drop to their own bucket
-            chunk = tuple(self._pad_cols(p[:, lo:hi], bucket)
-                          for p in planes)
-            if self._route is not None and not pred_leaf:
-                out = self._jit_run_routed(self._route, chunk)
-            else:
-                out, lf = self._jit_run(self._stk, chunk)
-                if pred_leaf:
-                    leaves[lo:hi] = np.asarray(lf)[:, :m].T
-            acc[lo:hi] = np.asarray(out)[:, :m].T
+        self.predict_calls += 1
+        with obs_trace.span("serve.predict", rows=n,
+                            trees=self.num_trees):
+            for lo in range(0, max(n, 1), step):
+                hi = min(lo + step, n)
+                m = hi - lo
+                bucket = self._bucket(m)   # tail chunks drop to their own
+                chunk = tuple(self._pad_cols(p[:, lo:hi], bucket)
+                              for p in planes)
+                cc0 = self.compile_count
+                with obs_trace.span("serve.score", bucket=bucket,
+                                    rows=m):
+                    if self._route is not None and not pred_leaf:
+                        out = self._jit_run_routed(self._route, chunk)
+                    else:
+                        out, lf = self._jit_run(self._stk, chunk)
+                        if pred_leaf:
+                            leaves[lo:hi] = np.asarray(lf)[:, :m].T
+                if self.compile_count == cc0:
+                    self.cache_hits += 1   # bucket program already compiled
+                else:
+                    log.event("serve_compile", bucket=bucket,
+                              routed=self._route is not None
+                              and not pred_leaf,
+                              compile_count=self.compile_count)
+                acc[lo:hi] = np.asarray(out)[:, :m].T
         return acc, leaves
 
     # -- bulk row-sharded scoring -----------------------------------------

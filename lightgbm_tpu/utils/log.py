@@ -5,8 +5,9 @@ reference's C API installs one so logs flow to Python/R).
 """
 from __future__ import annotations
 
+import json
 import sys
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 FATAL, WARNING, INFO, DEBUG = -1, 0, 1, 2
 
@@ -62,3 +63,30 @@ def fatal(msg: str) -> None:
     """Always raises (reference Log::Fatal throws)."""
     _emit("Fatal", msg)
     raise RuntimeError(msg)
+
+
+_EVENT_PREFIX = "[LightGBM-TPU] [Event] "
+
+
+def event(kind: str, **fields: Any) -> None:
+    """Structured channel: one machine-parseable JSON record through the
+    same callback seam as the human lines (INFO level, so `verbosity=0`
+    silences events exactly like info text). Human-facing lines stay
+    unchanged — events are ADDITIONAL `[Event]`-tagged lines that
+    `parse_event` round-trips."""
+    if _level >= INFO:
+        rec = {"event": kind}
+        rec.update(fields)
+        _emit("Event", json.dumps(rec, sort_keys=True, default=str))
+
+
+def parse_event(line: str) -> Optional[Dict[str, Any]]:
+    """Inverse of `event`: the record dict for an `[Event]` line, None
+    for any other line (including malformed event payloads)."""
+    if not line.startswith(_EVENT_PREFIX):
+        return None
+    try:
+        rec = json.loads(line[len(_EVENT_PREFIX):])
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None

@@ -30,6 +30,12 @@ class BuildPyWithNative(build_py):
         if not os.path.isdir(NATIVE_SRC):
             return
         dest = os.path.join(self.build_lib, "lightgbm_tpu", "_native_src")
+        # in-place / editable builds can resolve build_lib to the checkout
+        # itself — never stage into the in-tree package directory
+        in_tree = os.path.join(ROOT, "lightgbm_tpu")
+        if os.path.realpath(dest).startswith(os.path.realpath(in_tree)
+                                             + os.sep):
+            return
         os.makedirs(dest, exist_ok=True)
         for name in os.listdir(NATIVE_SRC):
             if name.endswith((".cpp", ".h")) or name == "Makefile":

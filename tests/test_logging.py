@@ -3,8 +3,8 @@
 import numpy as np
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.utils.log import (register_callback, set_verbosity,
-                                    fatal, info, warning)
+from lightgbm_tpu.utils.log import (event, parse_event, register_callback,
+                                    set_verbosity, fatal, info, warning)
 
 
 def test_levels_and_callback():
@@ -24,6 +24,28 @@ def test_levels_and_callback():
         except RuntimeError:
             raised = True
         assert raised and lines[-1].endswith("boom")
+    finally:
+        register_callback(None)
+        set_verbosity(1)
+
+
+def test_event_channel_roundtrip():
+    lines = []
+    register_callback(lines.append)
+    try:
+        set_verbosity(1)
+        event("train_path", path="aligned", gate_notes=["spill"])
+        rec = parse_event(lines[-1])
+        assert rec == {"event": "train_path", "path": "aligned",
+                       "gate_notes": ["spill"]}
+        # non-event lines parse to None rather than raising
+        info("plain message")
+        assert parse_event(lines[-1]) is None
+        # events ride the INFO level: silenced at verbosity < 1
+        set_verbosity(0)
+        n = len(lines)
+        event("hidden", x=1)
+        assert len(lines) == n
     finally:
         register_callback(None)
         set_verbosity(1)

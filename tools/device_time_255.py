@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Per-term DEVICE-time breakdown of the 255-bin aligned round.
 
-Times each term of the round the way tools/device_time_r4.py does — the
-kernel chained k times inside one jitted fori_loop, per-exec seconds =
-(t_K - t_1) / (K - 1), so host dispatch / tunnel overhead cancels:
+Thin CLI over ``lightgbm_tpu.obs.devicetime`` — the chained-k protocol
+(kernel chained k times inside one jitted fori_loop, per-exec seconds =
+(t_K - t_1) / (K - 1), so host dispatch / tunnel overhead cancels)
+lives there; this file only builds the 255-bin term closures:
 
   hist        slot_hist_pass over the full record store (root-shape,
               sub-binned accumulation when the layout enables it)
@@ -29,7 +30,6 @@ in tests/test_subbin_spill.py runs a tiny shape this way).
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -53,29 +53,9 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def dget(x):
-    return np.asarray(jax.device_get(
-        jax.tree_util.tree_leaves(x)[0].reshape(-1)[:1]))
-
-
-def dev_time(mk_fn, *args):
-    """mk_fn(k) -> jitted fn running the kernel k times; returns per-exec
-    seconds from the k=1 vs k=CHAIN delta."""
-    f1, fK = mk_fn(1), mk_fn(CHAIN)
-    for f in (f1, fK):          # compile + warm
-        dget(f(*args))
-    ts = []
-    for f in (f1, fK):
-        t0 = time.perf_counter()
-        for _ in range(REPS):
-            out = f(*args)
-        dget(out)
-        ts.append((time.perf_counter() - t0) / REPS)
-    return max((ts[1] - ts[0]) / (CHAIN - 1), 0.0)
-
-
 def main():
     from lightgbm_tpu.config import Config
+    from lightgbm_tpu.obs.devicetime import TermTimer
     from lightgbm_tpu.ops.aligned import hist_layout, move_pass, \
         pack_records, pack_route2, slot_hist_pass
     from lightgbm_tpu.ops.split import SplitHyper, make_split_finder
@@ -101,8 +81,9 @@ def main():
         f"spill={spill} ({slot_bytes >> 10} KB/slot, "
         f"budget {budget >> 20} MB)")
 
-    out = {"n": N, "features": F, "max_bin": MB, "chunk": C,
-           "subbin": subbin, "spill": spill, "terms_ms": {}}
+    tt = TermTimer({"n": N, "features": F, "max_bin": MB, "chunk": C,
+                    "subbin": subbin, "spill": spill},
+                   chain=CHAIN, reps=REPS, log=log)
 
     # ---- route / flush: every block splits at mid-bin -----------------
     r1 = np.full(NC, (MB // 2) | (1 << 13), np.int32)
@@ -132,20 +113,9 @@ def main():
             return f
         return mk
 
-    for name, hsl in (("route", nohist),
-                      ("hist_move", np.zeros(NC, np.int32))):
-        try:
-            per = dev_time(mk_move(hsl), rec)
-            out["terms_ms"][name] = round(per * 1e3, 2)
-            log(f"# {name}: {per * 1e3:.1f}ms ({per / N * 1e9:.2f}ns/row)")
-        except Exception as e:
-            log(f"# {name} FAILED {type(e).__name__} {str(e)[:200]}")
-            out["terms_ms"][name] = None
-    if out["terms_ms"].get("hist_move") is not None \
-            and out["terms_ms"].get("route") is not None:
-        out["terms_ms"]["flush"] = round(
-            max(out["terms_ms"].pop("hist_move")
-                - out["terms_ms"]["route"], 0.0), 2)
+    tt.measure("route", mk_move(nohist), rec, rows=N)
+    tt.measure("hist_move", mk_move(np.zeros(NC, np.int32)), rec, rows=N)
+    tt.derive("flush", "hist_move", "route")
 
     # ---- hist: the full root-shape slot_hist_pass ---------------------
     slots = np.zeros(NC, np.int32)
@@ -166,13 +136,7 @@ def main():
             return lax.fori_loop(0, k, body, (r, jnp.float32(0.0)))
         return f
 
-    try:
-        per = dev_time(mk_hist, rec)
-        out["terms_ms"]["hist"] = round(per * 1e3, 2)
-        log(f"# hist: {per * 1e3:.1f}ms ({per / N * 1e9:.2f}ns/row)")
-    except Exception as e:
-        log(f"# hist FAILED {type(e).__name__} {str(e)[:200]}")
-        out["terms_ms"]["hist"] = None
+    tt.measure("hist", mk_hist, rec, rows=N)
 
     # ---- split_eval: the finder over a changed-children batch ---------
     fmeta = {
@@ -204,15 +168,9 @@ def main():
             return lax.fori_loop(0, k, body, (h, jnp.float32(0.0)))
         return f
 
-    try:
-        per = dev_time(mk_split, hist_b)
-        out["terms_ms"]["split_eval"] = round(per * 1e3, 2)
-        log(f"# split_eval[{SPLITK}]: {per * 1e3:.1f}ms")
-    except Exception as e:
-        log(f"# split_eval FAILED {type(e).__name__} {str(e)[:200]}")
-        out["terms_ms"]["split_eval"] = None
+    tt.measure("split_eval", mk_split, hist_b)
 
-    print(json.dumps(out), flush=True)
+    print(json.dumps(tt.out), flush=True)
 
 
 if __name__ == "__main__":

@@ -3,12 +3,15 @@
 jitted program (fori_loop), time via device_get deltas between k=1 and
 k=K. Removes host dispatch / tunnel overhead from the numbers.
 
+Thin CLI over ``lightgbm_tpu.obs.devicetime.chained_device_time`` (the
+shared protocol implementation); this file only builds the move/hist
+closures and prints the human-readable per-C lines.
+
 python tools/device_time_r4.py [n] [max_bin] [C ...]
 """
 import functools
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,29 +28,8 @@ S = 64
 K = 8
 
 
-def dget(x):
-    return np.asarray(jax.device_get(
-        jax.tree_util.tree_leaves(x)[0].reshape(-1)[:1]))
-
-
-def dev_time(mk_fn, *args):
-    """mk_fn(k) -> jitted fn running the kernel k times. Returns (per-exec
-    seconds, total-k time)."""
-    f1, fK = mk_fn(1), mk_fn(K)
-    for f in (f1, fK):          # compile + warm
-        dget(f(*args))
-    reps = 3
-    ts = []
-    for f in (f1, fK):
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = f(*args)
-        dget(out)
-        ts.append((time.perf_counter() - t0) / reps)
-    return (ts[1] - ts[0]) / (K - 1), ts
-
-
 def main():
+    from lightgbm_tpu.obs.devicetime import chained_device_time
     from lightgbm_tpu.ops.aligned import move_pass, pack_records, \
         pack_route2, slot_hist_pass
 
@@ -95,22 +77,22 @@ def main():
             return f
 
         try:
-            per, ts = dev_time(functools.partial(
+            per, ts = chained_device_time(functools.partial(
                 mk_move, hsl=nohist, r1v=r1, metav=meta, blv=basel,
-                brv=baser), rec)
+                brv=baser), rec, chain=K)
             print(f"C={C}: move_split_nohist dev={per*1e3:.1f}ms "
                   f"({per/N*1e9:.2f}ns/row) [t1={ts[0]*1e3:.0f} "
                   f"tK={ts[1]*1e3:.0f}]", flush=True)
-            per, ts = dev_time(functools.partial(
+            per, ts = chained_device_time(functools.partial(
                 mk_move, hsl=np.zeros(NC, np.int32), r1v=r1, metav=meta,
-                blv=basel, brv=baser), rec)
+                blv=basel, brv=baser), rec, chain=K)
             print(f"C={C}: move_split_hist  dev={per*1e3:.1f}ms "
                   f"({per/N*1e9:.2f}ns/row)", flush=True)
             r1c = np.full(NC, (1 << 16), np.int32)
             metac = (meta_cnt | (1 << 20) | (1 << 21)).astype(np.int32)
-            per, ts = dev_time(functools.partial(
+            per, ts = chained_device_time(functools.partial(
                 mk_move, hsl=nohist, r1v=r1c, metav=metac, blv=iota,
-                brv=iota), rec)
+                brv=iota), rec, chain=K)
             print(f"C={C}: move_all_copy    dev={per*1e3:.1f}ms "
                   f"({per/N*1e9:.2f}ns/row)", flush=True)
         except Exception as e:
@@ -137,7 +119,7 @@ def main():
             return f
 
         try:
-            per, ts = dev_time(mk_hist, rec)
+            per, ts = chained_device_time(mk_hist, rec, chain=K)
             print(f"C={C}: hist_full        dev={per*1e3:.1f}ms "
                   f"({per/N*1e9:.2f}ns/row)", flush=True)
         except Exception as e:
