@@ -62,6 +62,7 @@ Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
 BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1,
+BENCH_SKIP_RESUME=1,
 BENCH_OUT=<path> (sidecar record), BENCH_TRACE=1 + BENCH_TRACE_DIR
 (obs span tracer + per-stage ledger records).
 LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
@@ -505,6 +506,43 @@ def warm_rerun_child() -> None:
               compile_cache.persistent_cache_dir())})
 
 
+def run_resume(X, y, leaves, iters):
+    """Checkpoint-write overhead + resume warm-up (resilience/): train
+    with tpu_checkpoint_freq=10 against a plain run of the same length,
+    then resume the final checkpoint into a fresh booster."""
+    import shutil
+    import tempfile
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1}
+    ckdir = tempfile.mkdtemp(prefix="bench_ck_")
+    try:
+        ds = lgb.Dataset(X, label=y, params=params).construct()
+        t0 = time.perf_counter()
+        lgb.train(dict(params), ds, num_boost_round=iters)
+        base_s = time.perf_counter() - t0
+        pc = dict(params, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=10)
+        ds2 = lgb.Dataset(X, label=y, params=params).construct()
+        bst = lgb.train(pc, ds2, num_boost_round=iters)
+        stats = bst._resilience
+        overhead_pct = round(100.0 * stats["ckpt_write_s"]
+                             / max(base_s, 1e-9), 2)
+        # resume warm-up: restore the final checkpoint into a fresh run
+        # (one extra round so the loop body executes once)
+        ds3 = lgb.Dataset(X, label=y, params=params).construct()
+        res = lgb.train(pc, ds3, num_boost_round=iters + 1)
+        warm_s = round(res._resilience["resume_warmup_s"], 4)
+        log(f"# resume: ckpt_writes={stats['ckpt_writes']} "
+            f"write_s={stats['ckpt_write_s']:.3f} "
+            f"overhead={overhead_pct}% warmup_s={warm_s} "
+            f"(resumed_from={res._resilience['resumed_from']})")
+        return {"ckpt_write_overhead_pct": overhead_pct,
+                "resume_warmup_s": warm_s,
+                "ckpt_writes": stats["ckpt_writes"]}
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def run_warm_rerun(out):
     """Spawn the fresh-process warm rerun and record cold vs warm."""
     import subprocess
@@ -675,6 +713,17 @@ def main() -> None:
         out["valid_overhead_pct"] = round(
             (per_valid / base_per - 1.0) * 100.0, 1)
         _stage_done("valid_overhead", out)
+
+    # ---- stage 5.5: checkpoint/resume cost (resilience/) ---------------
+    if stage_gate(out, "resume", "BENCH_SKIP_RESUME"):
+        _stage("resume")
+        try:
+            rr = run_resume(X[:200_000], y[:200_000], leaves,
+                            20 if smoke else 60)
+            out.update(rr)
+        except Exception as e:   # the summary line must still print
+            log(f"# resume stage FAILED: {type(e).__name__}: {e}")
+        _stage_done("resume", out)
 
     # ---- stage 6: fresh-process warm rerun (certifies the persistent
     # cache: the child re-pays binning but should load, not compile) ----

@@ -86,6 +86,77 @@ else
     rm -rf "$(dirname "$TRACE_DIR")"
 fi
 
+echo "== kill-and-resume smoke (SIGTERM mid-run -> exit 75 -> resume) =="
+RES_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_resume"
+mkdir -p "$RES_DIR"
+python - <<EOF
+import numpy as np
+rng = np.random.RandomState(11)
+X = rng.rand(20000, 20).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(20000) > 0.5).astype(np.float32)
+np.savetxt("$RES_DIR/train.tsv",
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+CLI_ARGS="task=train data=$RES_DIR/train.tsv objective=binary
+          num_leaves=31 num_iterations=30 verbosity=-1
+          output_model=$RES_DIR/model.txt
+          tpu_checkpoint_dir=$RES_DIR/ckpt tpu_checkpoint_freq=5
+          tpu_trace=true tpu_trace_dir=$RES_DIR/trace"
+# shellcheck disable=SC2086
+python -m lightgbm_tpu $CLI_ARGS > "$RES_DIR/run1.log" 2>&1 &
+CLI_PID=$!
+# wait until the round loop is demonstrably running (>=3 committed round
+# records), then preempt it with a real external SIGTERM
+for _ in $(seq 1 240); do
+    N=$(grep -hc '"kind": "round"' "$RES_DIR"/trace/ledger-*.jsonl \
+        2>/dev/null || true)
+    [ "${N:-0}" -ge 3 ] && break
+    sleep 0.25
+done
+kill -TERM "$CLI_PID"
+set +e
+wait "$CLI_PID"
+RC1=$?
+set -e
+if [ "$RC1" -ne 75 ]; then
+    echo "FAIL: preempted CLI run exited $RC1 (want 75)" >&2
+    cat "$RES_DIR/run1.log" >&2
+    exit 1
+fi
+# rerun the SAME command: it must auto-resume and finish cleanly
+# shellcheck disable=SC2086
+python -m lightgbm_tpu $CLI_ARGS > "$RES_DIR/run2.log" 2>&1
+RES_SMOKE_DIR="$RES_DIR" python - <<'EOF'
+import glob
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+
+tdir = os.path.join(os.environ["RES_SMOKE_DIR"], "trace")
+paths = sorted(glob.glob(os.path.join(tdir, "ledger-*.jsonl")),
+               key=os.path.getmtime)
+assert len(paths) >= 2, f"want two run ledgers, got {paths}"
+rounds = []
+for p in paths[-2:]:
+    rounds.extend(r["round"] for r in obs_ledger.read_ledger(p)
+                  if r["kind"] == "round")
+assert sorted(rounds) == list(range(30)), \
+    f"killed+resumed ledgers must cover rounds 0..29 exactly once: " \
+    f"{sorted(rounds)}"
+resumed = [r for r in obs_ledger.read_ledger(paths[-1])
+           if r.get("kind") == "note" and r.get("note") == "resume"]
+assert resumed, "resumed run's ledger lacks the resume note"
+first_run = [r["round"] for r in obs_ledger.read_ledger(paths[-2])
+             if r["kind"] == "round"]
+print(f"kill-and-resume smoke: ok (killed after round {max(first_run)}, "
+      f"two ledgers cover 30 rounds exactly once)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "resume artifacts kept under $RES_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$RES_DIR")"
+fi
+
 echo "== tests ($MODE tier) =="
 if [ "$MODE" = "full" ]; then
     python -m pytest tests/ -q

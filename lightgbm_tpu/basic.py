@@ -924,16 +924,27 @@ class Booster:
         return self.__deepcopy__(None)
 
     def __deepcopy__(self, memo):
-        return Booster(model_str=self.model_to_string())
+        import copy as _copy
+        clone = Booster(model_str=self.model_to_string())
+        clone.best_iteration = self.best_iteration
+        clone.best_score = _copy.deepcopy(self.best_score, memo)
+        clone.params = _copy.deepcopy(self.params, memo)
+        clone.name_train_set = self.name_train_set
+        return clone
 
     def __getstate__(self):
+        # only the model string plus plain-data attributes cross the
+        # pickle boundary — the parked telemetry ledger handle
+        # (self._telemetry) holds open file state and stays behind
         return {"model_str": self.model_to_string(),
                 "best_iteration": self.best_iteration,
                 "best_score": self.best_score,
-                "params": self.params}
+                "params": self.params,
+                "name_train_set": self.name_train_set}
 
     def __setstate__(self, state):
         self.__init__(model_str=state["model_str"])
         self.best_iteration = state["best_iteration"]
         self.best_score = state["best_score"]
         self.params = state.get("params", {})
+        self.name_train_set = state.get("name_train_set", "training")

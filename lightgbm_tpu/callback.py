@@ -124,6 +124,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     best_iter: List = []
     best_score_list: List = []
     cmp_op: List = []
+    cmp_flags: List = []   # bigger_is_better per metric (checkpointable
+    #                        stand-in for the cmp_op lambdas)
     enabled = [True]
     first_metric = [""]
 
@@ -146,6 +148,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         for _, _, _, bigger_better in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
+            cmp_flags.append(bool(bigger_better))
             if bigger_better:
                 best_score.append(float("-inf"))
                 cmp_op.append(lambda x, y: x > y)
@@ -186,5 +189,37 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                     raise EarlyStopException(best_iter[i],
                                              best_score_list[i])
                 _final_iteration_check(env, eval_name, i)
+
+    # checkpoint/resume hooks (resilience/): the closure state above is
+    # not reachable from outside, so expose explicit (de)serialization.
+    # best_score_list entries are evaluation_result_list snapshots —
+    # JSON turns their tuples into lists, which unpack the same way.
+    def get_ckpt_state() -> Dict:
+        return {"best_score": list(best_score),
+                "best_iter": list(best_iter),
+                "best_score_list": [
+                    None if bsl is None else [list(x) for x in bsl]
+                    for bsl in best_score_list],
+                "cmp_flags": list(cmp_flags),
+                "enabled": enabled[0],
+                "first_metric": first_metric[0]}
+
+    def set_ckpt_state(state: Dict) -> None:
+        del best_score[:], best_iter[:], best_score_list[:]
+        del cmp_op[:], cmp_flags[:]
+        best_score.extend(state["best_score"])
+        best_iter.extend(state["best_iter"])
+        best_score_list.extend(
+            None if bsl is None else [tuple(x) for x in bsl]
+            for bsl in state["best_score_list"])
+        cmp_flags.extend(bool(f) for f in state["cmp_flags"])
+        cmp_op.extend((lambda x, y: x > y) if f else (lambda x, y: x < y)
+                      for f in cmp_flags)
+        enabled[0] = bool(state["enabled"])
+        first_metric[0] = state["first_metric"]
+
     _callback.order = 30
+    _callback.ckpt_key = "early_stopping"
+    _callback.get_ckpt_state = get_ckpt_state
+    _callback.set_ckpt_state = set_ckpt_state
     return _callback

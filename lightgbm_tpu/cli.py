@@ -82,10 +82,10 @@ class Application:
                                   str(self.config.num_threads))
 
     # ------------------------------------------------------------------
-    def run(self) -> None:
+    def run(self) -> int:
         task = self.config.task
         if task == "train":
-            self.train()
+            return self.train()
         elif task in ("predict", "prediction", "test"):
             self.predict()
         elif task in ("convert_model",):
@@ -94,6 +94,7 @@ class Application:
             self.refit()
         else:
             raise LightGBMError(f"Unknown task type {task}")
+        return 0
 
     # ------------------------------------------------------------------
     def _load_train_data(self):
@@ -116,16 +117,20 @@ class Application:
             valid_names.append(os.path.basename(vf))
         return train_set, valid_sets, valid_names
 
-    def train(self) -> None:
+    def train(self) -> int:
         cfg = self.config
         train_set, valid_sets, valid_names = self._load_train_data()
         if cfg.is_provide_training_metric:
             valid_sets = [train_set] + valid_sets
             valid_names = ["training"] + valid_names
         callbacks = []
-        if cfg.snapshot_freq > 0 and cfg.output_model:
+        if cfg.snapshot_freq > 0 and cfg.output_model \
+                and not cfg.tpu_checkpoint_dir:
+            # legacy model-only snapshots; with tpu_checkpoint_dir the
+            # engine writes full-state checkpoints instead
             callbacks.append(_snapshot_callback(cfg.output_model,
-                                                cfg.snapshot_freq))
+                                                cfg.snapshot_freq,
+                                                cfg.tpu_snapshot_keep))
         if cfg.tpu_trace:
             # CLI traced runs re-emit each round record on the
             # structured channel at metric frequency (snapshot-style:
@@ -147,7 +152,14 @@ class Application:
             dump = obs_trace.write(os.path.join(tdir,
                                                 "trace_summary.json"))
             print(f"Telemetry: span summary at {dump}")
+        if getattr(booster, "_preempted", False):
+            from .resilience import EXIT_PREEMPTED
+            print(f"Preempted mid-training; checkpoint flushed. "
+                  f"Partial model saved to {out} — rerun the same "
+                  f"command to resume.")
+            return EXIT_PREEMPTED
         print(f"Finished training. Model saved to {out}")
+        return 0
 
     # ------------------------------------------------------------------
     def predict(self) -> None:
@@ -219,11 +231,18 @@ class Application:
         print(f"Finished refitting. Model saved to {out}")
 
 
-def _snapshot_callback(output_model: str, freq: int):
+def _snapshot_callback(output_model: str, freq: int, keep: int = 3):
+    """Periodic model snapshots (reference gbdt.cpp:289-293), written
+    atomically (tmp + rename — a kill mid-write never leaves a torn
+    snapshot) with rolling retention of the newest `keep` files."""
+    from .resilience import atomic_write_text, prune_snapshots
+
     def _cb(env):
         it = env.iteration + 1
         if it % freq == 0:
-            env.model.save_model(f"{output_model}.snapshot_iter_{it}")
+            atomic_write_text(f"{output_model}.snapshot_iter_{it}",
+                              env.model.model_to_string())
+            prune_snapshots(output_model, keep)
     _cb.order = 100
     return _cb
 
@@ -234,11 +253,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("Usage: python -m lightgbm_tpu config=train.conf [key=value ...]")
         return 1
     try:
-        Application(argv).run()
+        rc = Application(argv).run()
     except LightGBMError as e:
         print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
         return 1
-    return 0
+    return int(rc or 0)
 
 
 if __name__ == "__main__":

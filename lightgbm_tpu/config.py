@@ -446,6 +446,40 @@ class Config:
     # Defaults to ./lgbt_trace when tpu_trace is on and no directory is
     # given
     tpu_trace_dir: str = ""
+    # resilient training runtime (resilience/): directory for
+    # full-training-state checkpoints — model text + the bagging/GOSS/
+    # DART and feature-sampling RNG streams + the f32 score arrays +
+    # iteration counter + early-stopping state — written atomically
+    # (tmp + rename behind a MANIFEST.json pointer) every
+    # tpu_checkpoint_freq rounds and once more on SIGTERM/SIGINT
+    # preemption (the in-flight round finishes first). When the
+    # directory already holds a valid manifest whose training signature
+    # matches, engine.train auto-resumes from it and continues BITWISE-
+    # identically to the uninterrupted run (bagging, multiclass and
+    # valid-set early stopping included). Empty: checkpointing off —
+    # the round loop takes one None check and issues zero device fences
+    tpu_checkpoint_dir: str = ""
+    # checkpoint cadence in rounds (with tpu_checkpoint_dir). 0 inherits
+    # snapshot_freq when that is positive, else 10
+    tpu_checkpoint_freq: int = 0
+    # rolling retention shared by checkpoints and the CLI's
+    # output_model.snapshot_iter_* files: keep the newest K, delete older
+    tpu_snapshot_keep: int = 3
+    # deterministic fault injection for tests/CI (also settable via the
+    # LGBT_FAULTS environment variable): comma-separated "kill@R"
+    # (SIGTERM to own pid before round R), "int@R" (SIGINT), and
+    # "transient@N" (raise a retriable error at the N-th device
+    # dispatch, 1-based). Every injected fault, retry and recovery is
+    # recorded as a ledger note and an [Event] log record
+    tpu_fault_spec: str = ""
+    # bounded retry with exponential backoff around device dispatch
+    # sites: how many times a transient dispatch error (injected, or an
+    # XlaRuntimeError naming UNAVAILABLE / ABORTED / DEADLINE_EXCEEDED /
+    # preemption) is retried before propagating. 0 disables the retry
+    # wrapper entirely (dispatches become plain calls)
+    tpu_retry_max: int = 2
+    # first retry backoff in seconds; doubles on every further attempt
+    tpu_retry_backoff_s: float = 0.05
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

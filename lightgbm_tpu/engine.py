@@ -66,7 +66,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
         init_booster = init_model
 
     booster = Booster(params=params, train_set=train_set)
-    if init_booster is not None:
+    # resilience (resilience/): checkpoint manager + auto-resume bundle.
+    # With tpu_checkpoint_dir unset both stay None and the loop below
+    # adds one None check per round — no fences, no other work
+    ckpt_mgr = None
+    resume_bundle = None
+    _r_cfg = getattr(booster, "_cfg", None)
+    if _r_cfg is not None and _r_cfg.tpu_checkpoint_dir:
+        from .resilience import checkpoint as _ckpt
+        from .resilience import resume as _resume
+        ckpt_mgr = _ckpt.CheckpointManager.from_config(_r_cfg)
+        resume_bundle = _resume.load_latest(ckpt_mgr)
+    if init_booster is not None and resume_bundle is None:
+        # a valid checkpoint already contains the init model's trees
         _seed_from_model(booster, init_booster)
     is_valid_contain_train = False
     train_data_name = "training"
@@ -115,40 +127,85 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    # main loop (engine.py:239-267)
-    for i in range(num_boost_round):
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None, telemetry=telemetry))
-        booster.update(fobj=fobj)
+    # resume after valid sets + callbacks exist: restore() overwrites the
+    # replayed valid scores and rehydrates callback closures (early stop)
+    start_iter = 0
+    resume_warmup_s = 0.0
+    if resume_bundle is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        start_iter = _resume.restore(booster, resume_bundle,
+                                     callbacks=callbacks)
+        resume_warmup_s = _time.perf_counter() - _t0
+    fault_plan = getattr(getattr(booster, "_gbdt", None), "_fault_plan",
+                         None)
+    preempted = False
+    guard = None
+    if ckpt_mgr is not None:
+        from .resilience.preempt import PreemptGuard
+        guard = PreemptGuard()
+        guard.install()
 
-        evaluation_result_list = []
-        if is_valid_contain_train:
-            evaluation_result_list.extend(
-                (train_data_name, m, v, b)
-                for _, m, v, b in booster.eval_train())
-        if reduced_valid_sets:
-            evaluation_result_list.extend(booster.eval_valid())
-        if feval is not None:
-            evaluation_result_list.extend(
-                _run_feval(feval, booster, train_data_name,
-                           is_valid_contain_train, name_valid_sets))
-        try:
-            for cb in callbacks_after:
+    # main loop (engine.py:239-267)
+    try:
+        for i in range(start_iter, num_boost_round):
+            if fault_plan is not None:
+                fault_plan.on_round(i)
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list,
-                    telemetry=telemetry))
-        except EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            evaluation_result_list = es.best_score
-            break
+                    evaluation_result_list=None, telemetry=telemetry))
+            booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if is_valid_contain_train:
+                evaluation_result_list.extend(
+                    (train_data_name, m, v, b)
+                    for _, m, v, b in booster.eval_train())
+            if reduced_valid_sets:
+                evaluation_result_list.extend(booster.eval_valid())
+            if feval is not None:
+                evaluation_result_list.extend(
+                    _run_feval(feval, booster, train_data_name,
+                               is_valid_contain_train, name_valid_sets))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list,
+                        telemetry=telemetry))
+            except EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                evaluation_result_list = es.best_score
+                break
+            if guard is not None and guard.triggered:
+                # finish-in-flight semantics: round i fully committed
+                # above; flush one final checkpoint and stop cleanly
+                ckpt_mgr.write(booster, i + 1, callbacks=callbacks,
+                               reason=guard.signal_name or "preempt")
+                preempted = True
+                break
+            if ckpt_mgr is not None and ckpt_mgr.due(i + 1):
+                ckpt_mgr.write(booster, i + 1, callbacks=callbacks,
+                               reason="periodic")
+    finally:
+        if guard is not None:
+            guard.uninstall()
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for data_name, eval_name, score, _ in (evaluation_result_list or []):
         booster.best_score[data_name][eval_name] = score
+    resilience_stats = None
+    if ckpt_mgr is not None or start_iter:
+        resilience_stats = {"resumed_from": start_iter,
+                            "resume_warmup_s": resume_warmup_s,
+                            "ckpt_writes": getattr(ckpt_mgr, "writes", 0),
+                            "ckpt_write_s": getattr(ckpt_mgr, "write_s",
+                                                    0.0),
+                            "preempted": preempted}
+    booster._preempted = preempted
+    booster._resilience = resilience_stats
     if not keep_training_booster:
         # round-trip through the model string (engine.py:271-272)
         fresh = Booster(model_str=booster.model_to_string())
@@ -159,6 +216,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # booster no longer holds — carry the handle so bst.telemetry
         # still resolves after train() returns
         fresh._telemetry = telemetry
+        fresh._preempted = preempted
+        fresh._resilience = resilience_stats
         return fresh
     return booster
 

@@ -274,10 +274,12 @@ class RF(GBDT):
             fmask = self.learner.feature_mask()
             idxs, count = self.learner.init_root_partition(
                 self.bag_data_indices, self.bag_data_cnt)
-            idxs, rec = self.learner.train(gdev[k], hdev[k], idxs,
-                                           count, fmask)
+            idxs, rec = self._dispatch_device(
+                "learner.train", self.learner.train,
+                gdev[k], hdev[k], idxs, count, fmask)
             return self.learner.record_to_tree(jax.device_get(rec), 1.0)
-        new_tree, leaf_map = self.learner.train(
+        new_tree, leaf_map = self._dispatch_device(
+            "learner.train", self.learner.train,
             gdev[k], hdev[k], self.bag_data_indices, self.bag_data_cnt)
         if (new_tree.num_leaves > 1 and self.objective is not None
                 and getattr(self.objective, "is_renew_tree_output",

@@ -15,6 +15,7 @@ once per eval.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -226,6 +227,14 @@ class GBDT:
             tdir = cfg.tpu_trace_dir or "lgbt_trace"
             obs_trace.enable(tdir)
             self.telemetry = obs_ledger.RoundLedger.for_training(tdir, cfg)
+        # resilience (resilience/): deterministic fault plan (param/env)
+        # and the retry wrapper around device dispatches. None/False on
+        # the default path — _dispatch_device is then a plain call
+        self._fault_plan = None
+        if cfg.tpu_fault_spec or os.environ.get("LGBT_FAULTS", ""):
+            from ..resilience.faults import FaultPlan
+            self._fault_plan = FaultPlan.from_config(
+                cfg, telemetry=self.telemetry)
 
     @staticmethod
     def _reshape_init_score(ds: Dataset) -> Optional[np.ndarray]:
@@ -333,6 +342,21 @@ class GBDT:
             return self._train_one_iter_impl(grad, hess)
         return self._train_one_iter_traced(grad, hess)
 
+    def _dispatch_device(self, what: str, fn, *args):
+        """Every learner/engine device dispatch funnels through here so
+        the resilience layer can inject deterministic faults and retry
+        transient device errors (resilience/retry.py). With no fault
+        plan and retries disabled this is a plain call."""
+        plan = self._fault_plan
+        if plan is None and self.cfg.tpu_retry_max <= 0:
+            return fn(*args)
+        from ..resilience.retry import call_with_retry
+        return call_with_retry(
+            fn, args, what=what, plan=plan,
+            max_retries=self.cfg.tpu_retry_max,
+            backoff_s=self.cfg.tpu_retry_backoff_s,
+            telemetry=self.telemetry)
+
     def _round_fence_target(self):
         """What to drain to observe this round's device time: the
         aligned engine's newest pending dispatch when the pipelined path
@@ -425,7 +449,8 @@ class GBDT:
             new_tree = Tree(2)
             leaf_map = {}
             if self._class_need_train[k] and self.train_data.num_features > 0:
-                new_tree, leaf_map = self.learner.train(
+                new_tree, leaf_map = self._dispatch_device(
+                    "learner.train", self.learner.train,
                     gdev[k], hdev[k], self.bag_data_indices,
                     self.bag_data_cnt)
             if new_tree.num_leaves > 1:
@@ -602,7 +627,9 @@ class GBDT:
             self._aligned_eng_ref = eng
         self._maybe_rebag(eng)
         fmasks = [self.learner.feature_mask() for _ in range(K)]
-        outs = [eng.train_iter_mc(k, self.shrinkage_rate, fmasks[k])
+        outs = [self._dispatch_device(
+                    "engine.train_iter_mc",
+                    eng.train_iter_mc, k, self.shrinkage_rate, fmasks[k])
                 for k in range(K)]
         # resolve the PREVIOUS iteration while this one runs on device
         redo = self._resolve_aligned_pending_mc()
@@ -900,7 +927,9 @@ class GBDT:
             scores = eng.row_scores_dev()
             gd, hd = self.objective.get_gradients(scores[None, :])
             grads = (gd[0], hd[0])
-        return eng.train_iter(self.shrinkage_rate, fmask, grads=grads)
+        return self._dispatch_device(
+            "engine.train_iter",
+            lambda: eng.train_iter(self.shrinkage_rate, fmask, grads=grads))
 
     def _aligned_pipeline_depth(self) -> int:
         """How many dispatched rounds may stay unresolved before the
@@ -1096,7 +1125,8 @@ class GBDT:
         """One fused device program per boosting iteration."""
         cfg = self.cfg
         fmask = self.learner.feature_mask()
-        new_score, idxs, rec = self.learner.train_iter_fused(
+        new_score, idxs, rec = self._dispatch_device(
+            "learner.train_iter_fused", self.learner.train_iter_fused,
             self.train_score.score, self.objective, self.shrinkage_rate,
             fmask)
         self.train_score.score = new_score
@@ -1149,12 +1179,15 @@ class GBDT:
             if not bagged:
                 # fresh identity partition created inside the fused program:
                 # contiguous root histogram, no init-partition dispatch
-                idxs, rec = self.learner.train_fresh(gdev[k], hdev[k], fmask)
+                idxs, rec = self._dispatch_device(
+                    "learner.train_fresh", self.learner.train_fresh,
+                    gdev[k], hdev[k], fmask)
             else:
                 idxs, count = self.learner.init_root_partition(
                     self.bag_data_indices, self.bag_data_cnt)
-                idxs, rec = self.learner.train(gdev[k], hdev[k], idxs, count,
-                                               fmask)
+                idxs, rec = self._dispatch_device(
+                    "learner.train", self.learner.train,
+                    gdev[k], hdev[k], idxs, count, fmask)
             lazy = LazyTree(rec, self.shrinkage_rate, init_scores[k],
                             self.learner, max(cfg.num_leaves - 1, 1))
             self.models.append(lazy)

@@ -16,6 +16,15 @@ import numpy as np
 from ..config import Config
 from .tree import Tree
 
+# resilience-runtime knobs stay out of the serialized parameter dump: a
+# checkpointed/fault-injected run must produce byte-identical model text
+# to a plain run of the same training config (the bitwise-resume tests
+# diff whole model strings). Pre-existing runtime params keep dumping so
+# existing golden model files stay stable.
+_RUNTIME_ONLY_PARAMS = frozenset({
+    "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
+    "tpu_fault_spec", "tpu_retry_max", "tpu_retry_backoff_s"})
+
 
 def _feature_infos(mappers) -> List[str]:
     out = []
@@ -76,6 +85,8 @@ def save_model_to_string(models: List[Tree], cfg: Config,
     lines.append("")
     lines.append("parameters:")
     for k, v in sorted(cfg.to_dict().items()):
+        if k in _RUNTIME_ONLY_PARAMS:
+            continue
         if isinstance(v, list):
             v = ",".join(str(x) for x in v)
         lines.append(f"[{k}: {v}]")

@@ -1,0 +1,35 @@
+"""Resilient training runtime: atomic full-state checkpoints, bitwise
+resume, preemption handling, and a deterministic fault-injection harness.
+
+Four parts (see docs/Resilience.md):
+
+- ``checkpoint``: full-training-state checkpoints — model text, the
+  bagging/GOSS/DART + feature-sampling RNG streams, the f32 score
+  arrays, the iteration counter and early-stopping state — written
+  atomically (payload directory staged under a tmp name, ``os.replace``
+  renamed, then a MANIFEST.json pointer tmp+renamed) on a rolling
+  retention window.
+- ``resume``: restore that continues training bitwise-identically to
+  the uninterrupted run, by reinstalling the captured RNG streams and
+  score arrays rather than replaying them.
+- ``preempt``: SIGTERM/SIGINT handling scoped to the round loop — the
+  in-flight round finishes, checkpoint + ledger flush, and the CLI
+  exits with EXIT_PREEMPTED (75, EX_TEMPFAIL).
+- ``faults`` + ``retry``: param/env-driven deterministic fault
+  injection (kill at round R, transient error at the N-th device
+  dispatch) and bounded retry-with-backoff around dispatch sites, with
+  every fault/retry/recovery recorded as ledger notes and log events.
+"""
+from .checkpoint import (CheckpointManager, atomic_write_text,
+                         prune_snapshots, training_signature)
+from .faults import FaultPlan, InjectedTransientError
+from .preempt import EXIT_PREEMPTED, PreemptGuard
+from .resume import load_latest, restore
+from .retry import call_with_retry, is_transient
+
+__all__ = [
+    "CheckpointManager", "atomic_write_text", "prune_snapshots",
+    "training_signature", "FaultPlan", "InjectedTransientError",
+    "EXIT_PREEMPTED", "PreemptGuard", "load_latest", "restore",
+    "call_with_retry", "is_transient",
+]

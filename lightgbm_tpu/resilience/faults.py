@@ -1,0 +1,111 @@
+"""Deterministic fault injection: prove the resilience machinery works
+by killing training at an exact round or failing an exact device
+dispatch — from a param (``tpu_fault_spec``) or environment variable
+(``LGBT_FAULTS``), so tests and CI drive it without code changes.
+
+Spec grammar (comma-separated, all indices deterministic):
+
+- ``kill@R``       SIGTERM to own pid before round R runs — the
+                   PreemptGuard machinery (finish round, checkpoint,
+                   exit 75) is exercised end to end, not simulated.
+- ``int@R``        same with SIGINT.
+- ``transient@N``  raise :class:`InjectedTransientError` at the N-th
+                   device dispatch (1-based, counted across the whole
+                   run) — exercises retry.py's backoff loop. The error
+                   raises BEFORE the real dispatch runs, so donated
+                   buffers are untouched and the retry is exact.
+
+Every injected fault is recorded as a ledger ``note`` and an
+``[Event]`` log record, so a run's fault history is auditable from its
+telemetry alone.
+"""
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional, Set
+
+from ..utils import log
+
+
+class InjectedTransientError(RuntimeError):
+    """A deliberately-injected retriable device-dispatch failure."""
+
+
+class FaultPlan:
+    """Parsed fault spec + the mutable counters that make each fault
+    fire exactly once. One plan per GBDT instance (the dispatch counter
+    must be shared by every dispatch site)."""
+
+    def __init__(self, spec: str, telemetry=None) -> None:
+        self.spec = spec
+        self.telemetry = telemetry
+        self.kill_round: Optional[int] = None
+        self.kill_signal = signal.SIGTERM
+        self.transient_at: Set[int] = set()
+        self.dispatch_n = 0
+        self._killed = False
+        for tok in spec.split(","):
+            tok = tok.strip().lower()
+            if not tok:
+                continue
+            if "@" not in tok:
+                raise ValueError(f"bad fault token {tok!r} in {spec!r} "
+                                 "(want kind@index)")
+            kind, _, idx = tok.partition("@")
+            if not idx.lstrip("-").isdigit():
+                raise ValueError(f"bad fault index in {tok!r}")
+            at = int(idx)
+            if kind == "kill":
+                self.kill_round = at
+            elif kind == "int":
+                self.kill_round = at
+                self.kill_signal = signal.SIGINT
+            elif kind == "transient":
+                self.transient_at.add(at)
+            else:
+                raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
+
+    @classmethod
+    def from_config(cls, cfg, telemetry=None) -> Optional["FaultPlan"]:
+        spec = cfg.tpu_fault_spec or os.environ.get("LGBT_FAULTS", "")
+        if not spec:
+            return None
+        return cls(spec, telemetry=telemetry)
+
+    # ------------------------------------------------------------------
+    def note(self, what: str, **fields) -> None:
+        log.event("fault", fault=what, **fields)
+        if self.telemetry is not None:
+            self.telemetry.commit({"kind": "note", "note": what, **fields})
+
+    def on_round(self, round_idx: int) -> None:
+        """Engine pre-round hook: deliver the scheduled kill signal to
+        our own pid. With a PreemptGuard installed this drains
+        gracefully; without one the process dies — honest kill
+        semantics either way."""
+        if self._killed or self.kill_round is None \
+                or round_idx != self.kill_round:
+            return
+        self._killed = True
+        # "fault_kind": both log.event's first arg and the ledger record
+        # discriminator are already named "kind"
+        self.note("fault_injected", fault_kind="kill", round=round_idx,
+                  signal=signal.Signals(self.kill_signal).name)
+        os.kill(os.getpid(), self.kill_signal)
+
+    def next_dispatch(self) -> int:
+        """Count a LOGICAL device dispatch (retries of the same dispatch
+        keep its number)."""
+        self.dispatch_n += 1
+        return self.dispatch_n
+
+    def should_fail(self, dispatch_n: int) -> bool:
+        return dispatch_n in self.transient_at
+
+    def raise_transient(self, dispatch_n: int, what: str) -> None:
+        self.note("fault_injected", fault_kind="transient",
+                  dispatch=dispatch_n, site=what)
+        raise InjectedTransientError(
+            f"injected transient fault at device dispatch {dispatch_n} "
+            f"({what})")

@@ -1,0 +1,89 @@
+"""Preemption handling scoped to the round loop.
+
+TPU pools reclaim preemptible slices with SIGTERM; interactive runs get
+SIGINT. Either way the right response mid-training is the same: FINISH
+the in-flight round (its device work is already dispatched; abandoning
+it wastes the round and can leave donated buffers dangling), flush one
+final checkpoint + the ledger, and exit with a code schedulers can
+distinguish from a crash.
+
+``PreemptGuard`` is installed by ``engine.train`` just before the round
+loop when checkpointing is active, and uninstalled right after. The
+signal handler only sets a flag — it never raises into the middle of a
+device dispatch — and the loop checks the flag once per round at its
+existing post-round seam, so the pipelined paths keep their single
+round fence. A second SIGINT while the guard is draining restores the
+default behavior (an impatient operator can still kill the process).
+
+Exit code: 75 (BSD ``EX_TEMPFAIL`` — "temporary failure, retry later"),
+returned by the CLI so wrapper scripts can re-submit the same command,
+which auto-resumes from the manifest.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Dict, Optional
+
+from ..utils import log
+
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL: rerun the same command to resume
+
+
+class PreemptGuard:
+    """Flag-setting SIGTERM/SIGINT handler with install/uninstall."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self) -> None:
+        self.triggered = False
+        self.signal_name: Optional[str] = None
+        self._old: Dict[int, object] = {}
+        self._installed = False
+
+    def install(self) -> "PreemptGuard":
+        """Install handlers; inert (never triggers) when not on the
+        main thread — Python only allows signal handlers there."""
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        try:
+            for sig in self.SIGNALS:
+                self._old[sig] = signal.signal(sig, self._handle)
+            self._installed = True
+        except (ValueError, OSError):
+            self._old.clear()
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+        self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        name = signal.Signals(signum).name
+        if self.triggered and signum == signal.SIGINT:
+            # second Ctrl-C: stop draining, restore default, re-raise
+            self.uninstall()
+            raise KeyboardInterrupt
+        first = not self.triggered
+        self.triggered = True
+        self.signal_name = name
+        if first:
+            log.warning(f"{name} received: finishing the in-flight round, "
+                        "then flushing checkpoint + ledger "
+                        f"(exit code {EXIT_PREEMPTED})")
+            log.event("preempt", signal=name, pid=os.getpid())
+
+    # context-manager sugar for tests
+    def __enter__(self) -> "PreemptGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

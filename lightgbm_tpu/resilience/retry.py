@@ -1,0 +1,82 @@
+"""Bounded retry-with-backoff around device dispatch sites.
+
+Scope is deliberately narrow: only errors that look TRANSIENT are
+retried — the injected :class:`~.faults.InjectedTransientError`, and
+XLA runtime errors whose status text names a retriable condition
+(UNAVAILABLE / ABORTED / DEADLINE_EXCEEDED / preemption). Everything
+else propagates on the first raise: retrying a shape error or OOM loop
+only hides bugs.
+
+Caveat for real (non-injected) failures: a dispatch that donated its
+input buffers may leave them invalidated when it raises, in which case
+the retry fails fast with the resulting buffer error — best-effort by
+design. Injected faults raise BEFORE the real dispatch (faults.py), so
+the deterministic test path is always exact.
+
+Every retry and the eventual recovery/give-up is recorded as a ledger
+note and an ``[Event]`` record.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from ..utils import log
+from .faults import FaultPlan, InjectedTransientError
+
+# substrings of XlaRuntimeError/RuntimeError text that mark a device
+# error worth retrying (TPU preemption/donation races surface this way)
+TRANSIENT_MARKERS = ("UNAVAILABLE", "ABORTED", "DEADLINE_EXCEEDED",
+                     "preempted", "preemption")
+
+
+def is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, InjectedTransientError):
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        text = str(exc)
+        return any(m in text for m in TRANSIENT_MARKERS)
+    return False
+
+
+def call_with_retry(fn: Callable, args: Tuple[Any, ...], *, what: str,
+                    plan: Optional[FaultPlan], max_retries: int,
+                    backoff_s: float, telemetry=None) -> Any:
+    """Run ``fn(*args)`` with fault injection + bounded exponential
+    backoff. `what` names the dispatch site in telemetry."""
+    n = plan.next_dispatch() if plan is not None else 0
+    attempt = 0
+    while True:
+        try:
+            if plan is not None and attempt == 0 and plan.should_fail(n):
+                plan.raise_transient(n, what)
+            out = fn(*args)
+            if attempt > 0:
+                log.event("retry_recovered", what=what, dispatch=n,
+                          attempts=attempt)
+                if telemetry is not None:
+                    telemetry.commit({"kind": "note",
+                                      "note": "retry_recovered",
+                                      "what": what, "dispatch": n,
+                                      "attempts": attempt})
+            return out
+        except Exception as exc:
+            if not is_transient(exc) or attempt >= max_retries:
+                if attempt > 0:
+                    log.event("retry_exhausted", what=what, dispatch=n,
+                              attempts=attempt, error=str(exc)[:200])
+                raise
+            delay = backoff_s * (2.0 ** attempt)
+            attempt += 1
+            log.warning(f"transient error in {what} (dispatch {n}), "
+                        f"retry {attempt}/{max_retries} in {delay:.3f}s: "
+                        f"{exc}")
+            log.event("retry", what=what, dispatch=n, attempt=attempt,
+                      delay_s=round(delay, 4), error=str(exc)[:200])
+            if telemetry is not None:
+                telemetry.commit({"kind": "note", "note": "retry",
+                                  "what": what, "dispatch": n,
+                                  "attempt": attempt,
+                                  "delay_s": round(delay, 4)})
+            if delay > 0:
+                time.sleep(delay)

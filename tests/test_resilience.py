@@ -1,0 +1,388 @@
+"""Resilient training runtime (`lightgbm_tpu.resilience`): atomic
+full-state checkpoints, bitwise-identical resume, preemption handling,
+fault injection + bounded retry, snapshot atomicity/retention, and
+Booster pickle/deepcopy parity.
+"""
+import copy
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import _snapshot_callback
+from lightgbm_tpu.obs import trace as obs_trace
+from lightgbm_tpu.resilience import (EXIT_PREEMPTED, CheckpointManager,
+                                     FaultPlan, InjectedTransientError,
+                                     atomic_write_text, load_latest,
+                                     prune_snapshots)
+from lightgbm_tpu.resilience.checkpoint import read_manifest
+
+
+def _data(seed=0, n=500, f=10, classes=2):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    if classes == 2:
+        y = (X[:, 0] + 0.3 * rng.rand(n) > 0.6).astype(np.float64)
+    else:
+        y = np.floor(X[:, 0] * classes * 0.999).astype(np.float64)
+    return X, y
+
+
+BAG = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
+       "bagging_fraction": 0.7, "bagging_freq": 1, "bagging_seed": 3,
+       "feature_fraction": 0.8, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _kill_resume_roundtrip(tmp_path, params, rounds, kill_at, data_kw=None,
+                           train_kw=None):
+    """Train uninterrupted; train with checkpoints + a scheduled kill
+    (graceful preemption: train() RETURNS a preempted booster); resume
+    from the flushed checkpoint. Returns (ref, preempted, resumed)."""
+    X, y = _data(**(data_kw or {}))
+    train_kw = train_kw or {}
+    ref = lgb.train(dict(params), lgb.Dataset(X, y),
+                    num_boost_round=rounds, **copy.deepcopy(train_kw))
+
+    ckdir = str(tmp_path / "ck")
+    pk = dict(params)
+    pk.update(tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=5,
+              tpu_fault_spec=f"kill@{kill_at}")
+    part = lgb.train(pk, lgb.Dataset(X, y), num_boost_round=rounds,
+                     **copy.deepcopy(train_kw))
+    assert part._preempted
+    assert part._resilience["preempted"]
+
+    pr = dict(params)
+    pr.update(tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=5)
+    res = lgb.train(pr, lgb.Dataset(X, y), num_boost_round=rounds,
+                    **copy.deepcopy(train_kw))
+    assert not res._preempted
+    # the kill lands pre-round `kill_at`; that round still completes
+    # (finish-in-flight), so the resume starts at kill_at + 1
+    assert res._resilience["resumed_from"] == kill_at + 1
+    return ref, part, res
+
+
+# ---------------------------------------------------------------------------
+# bitwise resume
+# ---------------------------------------------------------------------------
+
+def test_resume_bitwise_bagging(tmp_path):
+    ref, part, res = _kill_resume_roundtrip(tmp_path, BAG, rounds=20,
+                                            kill_at=9)
+    assert part.num_trees() == 10  # round 9 finished before the flush
+    assert res.model_to_string() == ref.model_to_string()
+
+
+def test_resume_bitwise_multiclass(tmp_path):
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "learning_rate": 0.1, "bagging_fraction": 0.8,
+              "bagging_freq": 2, "min_data_in_leaf": 5, "verbosity": -1}
+    ref, _, res = _kill_resume_roundtrip(
+        tmp_path, params, rounds=12, kill_at=6,
+        data_kw={"classes": 3, "n": 600})
+    assert res.model_to_string() == ref.model_to_string()
+
+
+def test_resume_early_stopping_parity(tmp_path):
+    X, y = _data()
+    Xv, yv = _data(seed=7)
+    params = dict(BAG, metric="binary_logloss")
+
+    def kw():
+        return {"valid_sets": [lgb.Dataset(Xv, yv)],
+                "valid_names": ["v"],
+                "early_stopping_rounds": 4, "verbose_eval": False}
+
+    ref = lgb.train(dict(params), lgb.Dataset(X, y), num_boost_round=40,
+                    **kw())
+    ckdir = str(tmp_path / "ck")
+    pk = dict(params)
+    pk.update(tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=3,
+              tpu_fault_spec="kill@5")
+    part = lgb.train(pk, lgb.Dataset(X, y), num_boost_round=40, **kw())
+    assert part._preempted
+    pr = dict(params)
+    pr.update(tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=3)
+    res = lgb.train(pr, lgb.Dataset(X, y), num_boost_round=40, **kw())
+    # early-stop closure state survived the round trip: same stopping
+    # point, same best iteration, byte-identical model
+    assert res.best_iteration == ref.best_iteration
+    assert res.model_to_string() == ref.model_to_string()
+    assert res.best_score["v"]["binary_logloss"] == \
+        ref.best_score["v"]["binary_logloss"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint mechanics
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_retention_and_manifest(tmp_path):
+    X, y = _data(n=300)
+    ckdir = str(tmp_path / "ck")
+    p = dict(BAG, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=2,
+             tpu_snapshot_keep=2)
+    lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    man = read_manifest(ckdir)
+    assert man is not None and man["latest"] == "ckpt_000010"
+    assert man["checkpoints"] == ["ckpt_000008", "ckpt_000010"]
+    on_disk = sorted(d for d in os.listdir(ckdir) if d.startswith("ckpt_"))
+    assert on_disk == man["checkpoints"]
+    for c in on_disk:
+        names = set(os.listdir(os.path.join(ckdir, c)))
+        assert {"model.txt", "state.json", "arrays.npz"} <= names
+
+
+def test_signature_mismatch_starts_fresh(tmp_path):
+    X, y = _data(n=300)
+    ckdir = str(tmp_path / "ck")
+    p = dict(BAG, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=2)
+    lgb.train(p, lgb.Dataset(X, y), num_boost_round=4)
+    # different training math => different signature => no resume
+    # (freq high enough that this run never overwrites the checkpoints)
+    p2 = dict(p, learning_rate=0.23, tpu_checkpoint_freq=100)
+    bst = lgb.train(p2, lgb.Dataset(X, y), num_boost_round=4)
+    assert bst._resilience["resumed_from"] == 0
+    # same math but different runtime knobs => signature matches
+    p3 = dict(p, tpu_snapshot_keep=7, tpu_retry_max=5)
+    bst3 = lgb.train(p3, lgb.Dataset(X, y), num_boost_round=6)
+    assert bst3._resilience["resumed_from"] == 4
+
+
+def test_corrupt_manifest_starts_fresh(tmp_path):
+    X, y = _data(n=300)
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    (ckdir / "MANIFEST.json").write_text("{ not json")
+    p = dict(BAG, tpu_checkpoint_dir=str(ckdir))
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=3)
+    assert bst._resilience["resumed_from"] == 0
+    assert bst.num_trees() == 3
+
+
+def test_checkpoint_excluded_from_model_params_dump(tmp_path):
+    """A checkpointed run's model text must equal a plain run's —
+    runtime knobs stay out of the serialized parameters block."""
+    X, y = _data(n=300)
+    plain = lgb.train(dict(BAG), lgb.Dataset(X, y), num_boost_round=5)
+    p = dict(BAG, tpu_checkpoint_dir=str(tmp_path / "ck"),
+             tpu_checkpoint_freq=100, tpu_retry_max=4)
+    ck = lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+    assert ck.model_to_string() == plain.model_to_string()
+    assert "tpu_checkpoint_dir" not in ck.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# fault injection + retry
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retried_and_recorded(tmp_path):
+    X, y = _data(n=300)
+    p = dict(BAG, tpu_fault_spec="transient@3", tpu_retry_max=2,
+             tpu_retry_backoff_s=0.0, tpu_trace=True,
+             tpu_trace_dir=str(tmp_path))
+    try:
+        bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+        assert bst.num_trees() == 5
+        led = bst.telemetry
+        notes = [r for r in led.records if r.get("kind") == "note"]
+        led.close()
+    finally:
+        obs_trace.disable()
+        obs_trace.reset()
+    kinds = [n["note"] for n in notes]
+    assert "fault_injected" in kinds
+    assert "retry" in kinds
+    assert "retry_recovered" in kinds
+
+
+def test_retry_disabled_raises():
+    X, y = _data(n=300)
+    p = dict(BAG, tpu_fault_spec="transient@3", tpu_retry_max=0)
+    with pytest.raises(InjectedTransientError):
+        lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+
+
+def test_fault_spec_parse_errors():
+    with pytest.raises(ValueError):
+        FaultPlan("kaboom")
+    with pytest.raises(ValueError):
+        FaultPlan("explode@4")
+    with pytest.raises(ValueError):
+        FaultPlan("kill@soon")
+    plan = FaultPlan("kill@3,transient@7")
+    assert plan.kill_round == 3
+    assert plan.kill_signal == signal.SIGTERM
+    assert plan.should_fail(7) and not plan.should_fail(6)
+    assert FaultPlan("int@2").kill_signal == signal.SIGINT
+
+
+def test_exit_preempted_constant():
+    # EX_TEMPFAIL: schedulers treat it as retry-me, distinct from crash
+    assert EXIT_PREEMPTED == 75
+
+
+def test_preempt_manifest_reflects_finished_round(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    X, y = _data(n=300)
+    p = dict(BAG, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=100,
+             tpu_fault_spec="kill@4")
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=20)
+    assert bst._preempted
+    man = read_manifest(ckdir)
+    assert man["loop_iter"] == 5  # round 4 finished, then the flush
+    assert bst.num_trees() == 5
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off
+# ---------------------------------------------------------------------------
+
+def test_resilience_off_issues_zero_fences(monkeypatch):
+    calls = []
+    monkeypatch.setattr(obs_trace, "_block",
+                        lambda x: calls.append(1) or x)
+    obs_trace.reset()
+    X, y = _data(n=300)
+    bst = lgb.train(dict(BAG), lgb.Dataset(X, y), num_boost_round=3)
+    assert bst._resilience is None
+    assert calls == [], "resilience-off training touched the trace fence"
+    assert obs_trace.fence_count == 0
+
+
+# ---------------------------------------------------------------------------
+# snapshot callback (CLI) atomicity + retention
+# ---------------------------------------------------------------------------
+
+def test_snapshot_callback_atomic_and_retained(tmp_path):
+    X, y = _data(n=300)
+    bst = lgb.train(dict(BAG), lgb.Dataset(X, y), num_boost_round=2)
+    out = str(tmp_path / "model.txt")
+    cb = _snapshot_callback(out, freq=1, keep=2)
+
+    class _Env:
+        model = bst
+        def __init__(self, it):
+            self.iteration = it
+
+    for it in range(5):
+        cb(_Env(it))
+    snaps = sorted(p for p in os.listdir(str(tmp_path))
+                   if "snapshot_iter_" in p)
+    assert snaps == ["model.txt.snapshot_iter_4", "model.txt.snapshot_iter_5"]
+    # no tmp litter, and each retained snapshot is a loadable model
+    assert not [p for p in os.listdir(str(tmp_path)) if p.startswith(".tmp")]
+    for p in snaps:
+        loaded = lgb.Booster(model_file=str(tmp_path / p))
+        assert loaded.num_trees() == bst.num_trees()
+
+
+def test_atomic_write_and_prune_units(tmp_path):
+    path = str(tmp_path / "f.txt")
+    atomic_write_text(path, "hello")
+    assert open(path).read() == "hello"
+    atomic_write_text(path, "world")
+    assert open(path).read() == "world"
+    base = str(tmp_path / "m.txt")
+    for it in (2, 4, 6, 10):
+        open(f"{base}.snapshot_iter_{it}", "w").write("x")
+    removed = prune_snapshots(base, keep=2)
+    assert sorted(removed) == [f"{base}.snapshot_iter_2",
+                               f"{base}.snapshot_iter_4"]
+    assert prune_snapshots(base, keep=0) == []
+
+
+# ---------------------------------------------------------------------------
+# Booster pickle / deepcopy parity
+# ---------------------------------------------------------------------------
+
+def test_booster_pickle_deepcopy_parity(tmp_path):
+    X, y = _data(n=300)
+    Xv, yv = _data(seed=5, n=200)
+    params = dict(BAG, metric="binary_logloss", tpu_trace=True,
+                  tpu_trace_dir=str(tmp_path))
+    try:
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=5,
+                        valid_sets=[lgb.Dataset(Xv, yv)],
+                        valid_names=["val"], verbose_eval=False)
+        bst.name_train_set = "custom_train"
+        assert bst._telemetry is not None  # parked handle present
+        text = bst.model_to_string()
+
+        clone = pickle.loads(pickle.dumps(bst))
+        assert clone.model_to_string() == text
+        assert clone.best_iteration == bst.best_iteration
+        assert clone.name_train_set == "custom_train"
+        assert dict(clone.best_score["val"]) == dict(bst.best_score["val"])
+        assert clone.params == bst.params
+
+        deep = copy.deepcopy(bst)
+        assert deep.model_to_string() == text
+        assert deep.best_iteration == bst.best_iteration
+        assert deep.name_train_set == "custom_train"
+        assert dict(deep.best_score["val"]) == dict(bst.best_score["val"])
+        np.testing.assert_allclose(deep.predict(X[:32]), bst.predict(X[:32]))
+        if bst.telemetry is not None:
+            bst.telemetry.close()
+    finally:
+        obs_trace.disable()
+        obs_trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# ledger continuity across kill/resume
+# ---------------------------------------------------------------------------
+
+def test_ledger_rounds_partition_across_resume(tmp_path):
+    """Graceful kill at round r commits rounds 0..r to the first ledger;
+    the resumed run's ledger starts at r+1 — together they cover every
+    round exactly once."""
+    X, y = _data(n=300)
+    ckdir = str(tmp_path / "ck")
+    tdir = str(tmp_path / "tr")
+    p = dict(BAG, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=4,
+             tpu_fault_spec="kill@6", tpu_trace=True, tpu_trace_dir=tdir)
+    try:
+        part = lgb.train(p, lgb.Dataset(X, y), num_boost_round=12)
+        part.telemetry.close()
+        first = [r["round"] for r in part.telemetry.round_records()]
+        p2 = dict(p)
+        p2.pop("tpu_fault_spec")
+        res = lgb.train(p2, lgb.Dataset(X, y), num_boost_round=12)
+        res.telemetry.close()
+        second = [r["round"] for r in res.telemetry.round_records()]
+    finally:
+        obs_trace.disable()
+        obs_trace.reset()
+    assert first == list(range(0, 7))
+    assert second == list(range(7, 12))
+    notes = [r["note"] for r in res.telemetry.records
+             if r.get("kind") == "note"]
+    assert "resume" in notes
+
+
+# ---------------------------------------------------------------------------
+# write-cost ceiling (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_checkpoint_write_overhead_under_5pct(tmp_path):
+    import time
+    X, y = _data(n=2000, f=20)
+    t0 = time.perf_counter()
+    lgb.train(dict(BAG), lgb.Dataset(X, y), num_boost_round=50)
+    base_s = time.perf_counter() - t0
+    p = dict(BAG, tpu_checkpoint_dir=str(tmp_path / "ck"),
+             tpu_checkpoint_freq=10)
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=50)
+    stats = bst._resilience
+    assert stats["ckpt_writes"] == 5
+    assert stats["ckpt_write_s"] < 0.05 * base_s, (
+        f"checkpoint writes cost {stats['ckpt_write_s']:.3f}s against a "
+        f"{base_s:.3f}s baseline (>5%)")
