@@ -25,14 +25,24 @@ is the full record; a killed run still leaves the stages that finished):
    "predict_speedup": <serve engine vs seed TreePredictor>}
 
 Stages run in value order (63-bin -> 255-bin -> MSLR -> predict ->
-valid-overhead -> warm-rerun -> reference parity LAST) and
-BENCH_BUDGET_S sets a wall-clock budget: once exceeded, remaining
-stages are skipped instead of the whole run timing out with no output.
-EVERY skipped stage records its reason (budget exhaustion or the env
+serve-traffic -> valid-overhead -> resume -> warm-rerun -> reference
+parity LAST) and BENCH_BUDGET_S sets a wall-clock budget enforced by an
+obs BudgetGate: a stage is skipped not only once the budget is
+exhausted but also ADAPTIVELY, when its estimated cost (derived from
+the measured walls of earlier stages, recorded under "stage_wall_s")
+no longer fits what remains — and iteration-count stages shrink via
+scale_iters before giving up entirely. A reserve slice is held back so
+finalize always lands a complete record (the r05 rc=124 failure mode).
+EVERY skipped stage records its reason (budget/adaptive skip or the env
 knob that disabled it) under "stage_skips" {stage: reason} — and the
 summary line re-emits at the moment of the skip, so a later hard kill
 can never produce rc=124 with nothing parseable. "budget_skipped"
 (name-only list) stays for older parsers.
+
+The serve-traffic stage (tools/bench_serve_traffic.py) loads two real
+boosters into the serving/ service and records open-loop p50/p99
+latency per target QPS, closed-loop coalesced-vs-direct throughput,
+batch fill, and a hot-swap-under-load leg with zero tolerated failures.
 
 Compile-cost accounting (first-class JSON fields): "warmup_s" /
 "warmup_s_255bin" (wall seconds of the warmup iterations, compile
@@ -41,6 +51,11 @@ iteration cost), "compile_cache_hit" (persistent cache had entries
 before this process compiled), "compile_cache" {dir, entries_before,
 entries_after}, and "warmup_s_warm" + "warm_speedup" from a
 fresh-process rerun of the 63-bin warmup leg (warm-rerun stage).
+"compile_cache_misses" {stage: count} attributes persistent-cache
+misses to the stage that paid them — each miss also emits a structured
+compile_cache_miss [Event] naming the traced program signature
+(compile_cache.install_cache_event_hooks), so a long warm-up despite
+compile_cache_hit=true is now a lookup, not an investigation.
 
 Aligned-path accounting: the 255-bin and MSLR stages record whether the
 run stayed on the aligned engine ("aligned_255bin" / "mslr_aligned"),
@@ -62,7 +77,7 @@ Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
 BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1,
-BENCH_SKIP_RESUME=1,
+BENCH_SKIP_RESUME=1, BENCH_SKIP_SERVE=1,
 BENCH_OUT=<path> (sidecar record), BENCH_TRACE=1 + BENCH_TRACE_DIR
 (obs span tracer + per-stage ledger records).
 LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
@@ -93,7 +108,7 @@ os.environ.setdefault("LGBT_COMPILE_CACHE_DIR", _cache)
 
 import lightgbm_tpu as lgb  # noqa: E402
 from lightgbm_tpu import compile_cache  # noqa: E402
-from lightgbm_tpu.obs.bench_record import BenchRecorder  # noqa: E402
+from lightgbm_tpu.obs.bench_record import BenchRecorder, BudgetGate  # noqa: E402
 
 BASELINE_S = 238.5       # docs/Experiments.rst:106 (CPU, 16 threads)
 BASELINE_MSLR_S = 215.3  # docs/Experiments.rst:110
@@ -101,8 +116,10 @@ BASELINE_ITERS = 500
 
 _T0 = time.perf_counter()
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+_GATE = BudgetGate(BUDGET_S, t0=_T0)
 _REC = None       # BenchRecorder owning the cumulative record (main only)
 _LEDGER = None    # optional obs RoundLedger for per-stage records
+_STAGE_MISS0 = {}  # persistent-cache miss count at each stage's start
 
 
 def log(msg):
@@ -121,14 +138,26 @@ def emit(out):
 
 
 def _stage(name):
-    """Mark a stage as reached (the interruption record names it)."""
+    """Mark a stage as reached (the interruption record names it), start
+    its wall clock, and snapshot the persistent-cache miss counter so
+    _stage_done can attribute recompiles to the stage."""
+    _GATE.start(name)
+    _STAGE_MISS0[name] = compile_cache.persistent_cache_events()["misses"]
     if _REC is not None:
         _REC.start_stage(name)
 
 
 def _stage_done(name, out):
-    """Stage completed: re-emit the cumulative record, flush the sidecar,
-    and append a stage record to the obs ledger when one is attached."""
+    """Stage completed: record its wall + compile-cache misses, re-emit
+    the cumulative record, flush the sidecar, and append a stage record
+    to the obs ledger when one is attached."""
+    wall = _GATE.done(name)
+    out.setdefault("stage_wall_s", {})[name] = round(wall, 2)
+    miss = compile_cache.persistent_cache_events()["misses"] \
+        - _STAGE_MISS0.pop(name, 0)
+    # which stage recompiled despite the warm cache — each miss also
+    # emitted a compile_cache_miss [Event] naming the exact program
+    out.setdefault("compile_cache_misses", {})[name] = miss
     if _REC is not None:
         _REC.stage_done(name)
     else:
@@ -139,28 +168,25 @@ def _stage_done(name, out):
 
 
 def budget_left():
-    """Seconds until the BENCH_BUDGET_S wall budget runs out (None =
-    unbounded)."""
-    if BUDGET_S <= 0:
-        return None
-    return BUDGET_S - (time.perf_counter() - _T0)
+    """Usable seconds until the BENCH_BUDGET_S wall budget runs out
+    (None = unbounded). A finalize reserve is already held back."""
+    return _GATE.left()
 
 
-def stage_gate(out, stage, env_knob=None):
+def stage_gate(out, stage, env_knob=None, est_s=0.0):
     """True when the stage should run. A skipped stage records WHY under
-    out["stage_skips"][stage] — the env knob that disabled it, or budget
-    exhaustion — and re-emits the summary line immediately, so a later
-    hard kill still leaves the skip reasons parseable on stdout."""
+    out["stage_skips"][stage] — the env knob that disabled it, budget
+    exhaustion, or an adaptive skip (est_s, usually derived from earlier
+    stages' measured walls, no longer fits the remaining budget) — and
+    re-emits the summary line immediately, so a later hard kill still
+    leaves the skip reasons parseable on stdout."""
     if env_knob and os.environ.get(env_knob) == "1":
         out.setdefault("stage_skips", {})[stage] = f"{env_knob}=1"
         emit(out)
         return False
-    left = budget_left()
-    if left is None or left > 0:
+    ok, reason = _GATE.allow(stage, est_s=est_s)
+    if ok:
         return True
-    elapsed = time.perf_counter() - _T0
-    reason = (f"BENCH_BUDGET_S={BUDGET_S:.0f} exhausted "
-              f"({elapsed:.0f}s elapsed)")
     log(f"# {reason}: skipping {stage}")
     out.setdefault("budget_skipped", []).append(stage)
     out.setdefault("stage_skips", {})[stage] = reason
@@ -652,7 +678,8 @@ def main() -> None:
     # ---- stage 2: 255-bin HIGGS (apples-to-apples vs the CPU table;
     # runs BEFORE the warm rerun / parity extras — it is the headline
     # gap this repo is closing, so a budget kill must not eat it) -------
-    if stage_gate(out, "255bin", "BENCH_SKIP_255"):
+    if stage_gate(out, "255bin", "BENCH_SKIP_255",
+                  est_s=_GATE.wall("higgs63") * 0.8):
         _stage("255bin")
         projected255, auc255, done255, stats255 = run_higgs(
             n, f, leaves, max(iters // 2, 2), warmup, 255,
@@ -672,11 +699,18 @@ def main() -> None:
 
     # ---- stage 3: MSLR lambdarank (second headline experiment; 255-bin
     # x F=137 — the aligned-path spill-ring shape) -----------------------
-    if stage_gate(out, "mslr", "BENCH_SKIP_RANK"):
+    if stage_gate(out, "mslr", "BENCH_SKIP_RANK",
+                  est_s=_GATE.wall("255bin", _GATE.wall("higgs63")) * 0.9):
         _stage("mslr")
         nm = 30_000 if smoke else 2_270_000
         fm = 20 if smoke else 137
         rit = 4 if smoke else 25
+        # shrink the measured window when the budget is tight (per-iter
+        # estimated from the 255-bin HIGGS wall scaled to MSLR's rows)
+        per_est = _GATE.wall("255bin", _GATE.wall("higgs63")) \
+            / max(iters // 2 + warmup, 1) * (nm / max(n, 1))
+        rit = _GATE.scale_iters(rit, per_est, overhead_s=per_est * 3,
+                                floor=2)
         mslr_s, nd, minfo = run_mslr(nm, fm, rit, 2, max_bin=255)
         out["ndcg10"] = round(nd, 6)
         out["mslr_500iter_s"] = round(mslr_s, 2)
@@ -688,7 +722,8 @@ def main() -> None:
         _stage_done("mslr", out)
 
     # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --
-    if stage_gate(out, "predict", "BENCH_SKIP_PREDICT"):
+    if stage_gate(out, "predict", "BENCH_SKIP_PREDICT",
+                  est_s=15 if smoke else 90):
         _stage("predict")
         try:
             from tools.bench_predict import run as bench_predict_run
@@ -703,10 +738,33 @@ def main() -> None:
             log(f"# predict stage FAILED: {type(e).__name__}: {e}")
         _stage_done("predict", out)
 
+    # ---- stage 4.5: serving traffic simulation (serving/ service:
+    # model registry + request coalescer + hot swap under load) ----------
+    if stage_gate(out, "serve_traffic", "BENCH_SKIP_SERVE",
+                  est_s=45 if smoke else 180):
+        _stage("serve_traffic")
+        try:
+            from tools.bench_serve_traffic import run as bench_serve_run
+            out.update(bench_serve_run(
+                models=2,
+                qps_list=(25, 100) if smoke else (50, 200, 800),
+                open_secs=1.0 if smoke else 2.0,
+                closed_secs=1.0 if smoke else 2.0,
+                clients=16 if smoke else 32,
+                train_rows=1_500 if smoke else 8_000,
+                train_rounds=20 if smoke else 60,
+                ledger=_LEDGER, verbose=True))
+        except Exception as e:   # the summary line must still print
+            log(f"# serve_traffic stage FAILED: {type(e).__name__}: {e}")
+        _stage_done("serve_traffic", out)
+
     # ---- stage 5: valid-set overhead (diagnostic) ----------------------
-    if stage_gate(out, "valid_overhead", "BENCH_SKIP_VALID"):
+    if stage_gate(out, "valid_overhead", "BENCH_SKIP_VALID",
+                  est_s=projected / BASELINE_ITERS * (5 if smoke else 14)):
         _stage("valid_overhead")
         vo_iters = 3 if smoke else 10
+        vo_iters = _GATE.scale_iters(
+            vo_iters, projected / BASELINE_ITERS * 1.2, floor=2)
         per_valid = run_valid_overhead(X, y, hX[:100_000], hy[:100_000],
                                        leaves, vo_iters, 2)
         base_per = projected / BASELINE_ITERS
@@ -715,7 +773,8 @@ def main() -> None:
         _stage_done("valid_overhead", out)
 
     # ---- stage 5.5: checkpoint/resume cost (resilience/) ---------------
-    if stage_gate(out, "resume", "BENCH_SKIP_RESUME"):
+    if stage_gate(out, "resume", "BENCH_SKIP_RESUME",
+                  est_s=_GATE.wall("higgs63") * 0.4):
         _stage("resume")
         try:
             rr = run_resume(X[:200_000], y[:200_000], leaves,
@@ -727,7 +786,8 @@ def main() -> None:
 
     # ---- stage 6: fresh-process warm rerun (certifies the persistent
     # cache: the child re-pays binning but should load, not compile) ----
-    if stage_gate(out, "warm_rerun", "BENCH_SKIP_WARM"):
+    if stage_gate(out, "warm_rerun", "BENCH_SKIP_WARM",
+                  est_s=_GATE.wall("higgs63") * 0.6):
         _stage("warm_rerun")
         run_warm_rerun(out)
         _stage_done("warm_rerun", out)
@@ -735,7 +795,8 @@ def main() -> None:
     # ---- stage 7: reference-binary parity (slowest, least perishable) --
     if smoke:
         out.setdefault("stage_skips", {})["ref_parity"] = "BENCH_SMOKE=1"
-    elif stage_gate(out, "ref_parity", "BENCH_SKIP_REF"):
+    elif stage_gate(out, "ref_parity", "BENCH_SKIP_REF",
+                    est_s=max(_GATE.wall("higgs63") * 2.0, 300)):
         _stage("ref_parity")
         auc_ours_1m, auc_ref = run_ref_parity(X, y, hX, hy, leaves)
         if auc_ref is not None:

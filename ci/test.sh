@@ -157,6 +157,71 @@ else
     rm -rf "$(dirname "$RES_DIR")"
 fi
 
+echo "== serving smoke (2 models, hot swap under threaded load) =="
+SERVE_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_serve"
+mkdir -p "$SERVE_DIR"
+LGBT_SERVE_SMOKE_DIR="$SERVE_DIR" python - <<'EOF'
+import json
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+from tools.bench_serve_traffic import run
+
+sdir = os.environ["LGBT_SERVE_SMOKE_DIR"]
+led_path = os.path.join(sdir, "serve-ledger.jsonl")
+ledger = obs_ledger.RoundLedger(led_path, {"smoke": "serving"})
+# two resident models; the hot-swap leg fires threaded requests on m0
+# while a retrained version swaps in
+res = run(models=2, qps_list=(25, 100), open_secs=1.0, closed_secs=1.0,
+          clients=16, train_rows=1500, train_rounds=20, ledger=ledger,
+          verbose=True)
+ledger.close()
+
+# zero failed requests anywhere — closed loops, QPS sweep, swap leg
+assert res["serve_hot_swap"]["requests_failed"] == 0, res["serve_hot_swap"]
+assert res["serve_hot_swap"]["requests_ok"] > 0
+assert res["serve_hot_swap"]["version_after"] == "v2"
+assert res["serve_closed_failures"] == 0
+assert all(q["failures"] == 0 for q in res["serve_qps_sweep"])
+
+# exactly-once swap note on the ledger (schema-validated)
+recs = obs_ledger.read_ledger(led_path)
+for rec in recs:
+    obs_ledger.validate_record(rec)
+swaps = [r for r in recs
+         if r.get("kind") == "note" and r.get("note") == "serve_swap"]
+assert len(swaps) == 1, f"want exactly one serve_swap note, got {swaps}"
+loads = [r for r in recs
+         if r.get("kind") == "note" and r.get("note") == "serve_load"]
+assert len(loads) == 2, f"want two serve_load notes, got {loads}"
+
+# schema-valid traffic record: QPS sweep with latency percentiles on
+# both resident models, and coalescing must beat per-request dispatch
+assert res["serve_models"] == 2
+assert len(res["serve_qps_sweep"]) >= 2
+for q in res["serve_qps_sweep"]:
+    assert isinstance(q["qps_target"], int)
+    assert q["p50_ms"] > 0 and q["p99_ms"] >= q["p50_ms"]
+for k in ("serve_direct_rows_s", "serve_coalesced_rows_s",
+          "serve_fill_ratio", "serve_resident_bytes"):
+    assert isinstance(res[k], (int, float)) and res[k] > 0, (k, res[k])
+assert res["coalesced_vs_direct"] > 1.0, res["coalesced_vs_direct"]
+assert res["serve_swaps"] == 1
+
+out_path = os.path.join(sdir, "serve_traffic.json")
+with open(out_path, "w") as fh:
+    json.dump(res, fh, sort_keys=True)
+print(f"serving smoke: ok (coalesced/direct="
+      f"{res['coalesced_vs_direct']}x, "
+      f"{res['serve_hot_swap']['requests_ok']} requests through the "
+      f"swap, record at {out_path})")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "serving artifacts kept under $SERVE_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$SERVE_DIR")"
+fi
+
 echo "== tests ($MODE tier) =="
 if [ "$MODE" = "full" ]; then
     python -m pytest tests/ -q

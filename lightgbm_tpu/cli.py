@@ -92,6 +92,8 @@ class Application:
             self.convert_model()
         elif task == "refit":
             self.refit()
+        elif task == "serve":
+            return self.serve()
         else:
             raise LightGBMError(f"Unknown task type {task}")
         return 0
@@ -211,6 +213,73 @@ class Application:
         with open_file(out, "w") as f:
             f.write(code)
         print(f"Finished converting model. Code saved to {out}")
+
+    # ------------------------------------------------------------------
+    def serve(self) -> int:
+        """Batch-mode driver for the serving service (serving/): load the
+        named models (``input_model=name=file[,name2=file2]``; a bare
+        path serves under its basename) and/or watch a checkpoint
+        directory (``tpu_checkpoint_dir=`` — hot-swaps while running),
+        then score ``data=`` through the request coalescer into
+        ``output_result``. Scores are RAW margins (the service
+        contract), i.e. what ``task=predict predict_raw_score=true``
+        writes. With no data file the models are loaded, stats print,
+        and the process exits — a smoke/validation mode."""
+        import json
+        cfg = self.config
+        from .serving import ServingService
+        if not cfg.input_model and not cfg.tpu_checkpoint_dir:
+            raise LightGBMError(
+                "task=serve needs input_model=<[name=]file,...> and/or "
+                "tpu_checkpoint_dir=<dir>")
+        svc = ServingService(params=dict(self.raw_params))
+        try:
+            names: List[str] = []
+            if cfg.input_model:
+                for i, spec in enumerate(
+                        s.strip() for s in cfg.input_model.split(",")
+                        if s.strip()):
+                    if "=" in spec:
+                        name, path = (t.strip()
+                                      for t in spec.split("=", 1))
+                    else:
+                        path = spec
+                        name = os.path.splitext(
+                            os.path.basename(spec))[0] or f"model{i}"
+                    svc.load_model(name, model_file=path)
+                    names.append(name)
+            if cfg.tpu_checkpoint_dir:
+                svc.watch("checkpoint", cfg.tpu_checkpoint_dir)
+                if svc.registry.get("checkpoint") is None:
+                    raise LightGBMError(
+                        f"no readable checkpoint manifest under "
+                        f"{cfg.tpu_checkpoint_dir}")
+                names.append("checkpoint")
+            if cfg.data:
+                loader = DatasetLoader(cfg)
+                _labels, feats, _ex = loader.parse_file(cfg.data)
+                target = names[0]
+                req_rows = max(min(cfg.tpu_serve_max_batch_rows, 1024), 1)
+                futs = [svc.predict_async(target, feats[s:s + req_rows])
+                        for s in range(0, len(feats), req_rows)]
+                preds = np.concatenate([np.atleast_1d(f.result(timeout=600))
+                                        for f in futs], axis=0)
+                out = cfg.output_result or "LightGBM_predict_result.txt"
+                from .io.file_io import open_file
+                with open_file(out, "w") as f:
+                    if preds.ndim == 1:
+                        for v in preds:
+                            f.write(f"{v:g}\n")
+                    else:
+                        for row in preds:
+                            f.write("\t".join(f"{v:g}" for v in row) + "\n")
+                print(f"Finished serving {len(preds)} rows on "
+                      f"{target!r}. Results saved to {out}")
+            print("Serving stats: "
+                  + json.dumps(svc.stats(), sort_keys=True, default=str))
+        finally:
+            svc.close()
+        return 0
 
     # ------------------------------------------------------------------
     def refit(self) -> None:

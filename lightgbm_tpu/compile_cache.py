@@ -28,6 +28,7 @@ performed zero traces.  This mirrors ``serve.ForestEngine.compile_count``.
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import threading
@@ -38,6 +39,7 @@ import numpy as np
 _lock = threading.Lock()
 _programs: Dict[Any, Callable] = {}
 _trace_count = 0
+_tls = threading.local()          # per-thread attribution tag
 
 
 def note_trace() -> None:
@@ -51,6 +53,55 @@ def trace_count() -> int:
     return _trace_count
 
 
+def program_tag(key: Any) -> str:
+    """Short human-stable tag for a registry key: its leading name (when
+    the key is the conventional ("name", ...) tuple) plus a digest of
+    the full shape/config signature. This is what a persistent-cache
+    MISS event carries — enough to say WHICH program at WHICH traced
+    signature recompiled (the 552 s warm-up attribution question)."""
+    name = "program"
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        name = key[0]
+    digest = hashlib.sha1(repr(key).encode()).hexdigest()[:10]
+    return f"{name}:{digest}"
+
+
+def current_attribution() -> Optional[str]:
+    """The program tag (or explicit `attribution` label) active on this
+    thread — what a compile-cache miss fired now would be blamed on."""
+    return getattr(_tls, "tag", None)
+
+
+@contextlib.contextmanager
+def attribution(tag: str):
+    """Label compiles dispatched inside the block (for paths that do not
+    go through `program()`, e.g. the serve engine's bucket programs or
+    a bench stage)."""
+    prev = getattr(_tls, "tag", None)
+    _tls.tag = tag
+    try:
+        yield
+    finally:
+        _tls.tag = prev
+
+
+def _attributed(key: Any, fn: Callable) -> Callable:
+    """Wrap a registered program so any compile its dispatch triggers is
+    attributed to its registry key (one thread-local store per call;
+    the jit trace cache keys on `fn`, which stays stable inside)."""
+    tag = program_tag(key)
+
+    def run(*args, **kwargs):
+        prev = getattr(_tls, "tag", None)
+        _tls.tag = tag
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _tls.tag = prev
+    run.__wrapped__ = fn
+    return run
+
+
 def program(key: Any, factory: Callable[[], Callable]) -> Callable:
     """Return the process-wide jitted program for ``key``, building it
     via ``factory()`` on first use. ``key`` must be hashable and must
@@ -60,7 +111,7 @@ def program(key: Any, factory: Callable[[], Callable]) -> Callable:
         with _lock:
             fn = _programs.get(key)
             if fn is None:
-                fn = factory()
+                fn = _attributed(key, factory())
                 _programs[key] = fn
     return fn
 
@@ -144,6 +195,67 @@ class HashableFn:
 
 
 _persistent_cache_dir: Optional[str] = None
+_pcache_hits = 0
+_pcache_misses = 0
+_hooks_installed = False
+
+
+def persistent_cache_events() -> Dict[str, int]:
+    """Counts of persistent-compile-cache hits/misses observed by the
+    jax hooks this process (zeros until `install_cache_event_hooks`)."""
+    return {"hits": _pcache_hits, "misses": _pcache_misses}
+
+
+def note_persistent_cache_miss(module_name: str, cache_key: str = "") -> None:
+    """Record one persistent-cache miss: bump the counter and emit a
+    structured `[Event]` carrying the XLA module name, the cache key,
+    and the traced program signature active on this thread — the data
+    needed to explain a long warm-up DESPITE compile_cache_hit=true
+    (which only says the cache directory was non-empty, not that every
+    program hit)."""
+    global _pcache_misses
+    _pcache_misses += 1
+    from .utils import log
+    log.event("compile_cache_miss", module=str(module_name),
+              key=str(cache_key)[:20], program=current_attribution())
+
+
+def _note_persistent_cache_hit(module_name: str, cache_key: str = "") -> None:
+    global _pcache_hits
+    _pcache_hits += 1
+
+
+def install_cache_event_hooks() -> bool:
+    """Wrap jax's persistent-cache logging seam
+    (`jax._src.compiler.log_persistent_cache_{miss,hit}` — called
+    exactly once per compile on the miss/hit path) so every miss lands
+    on the structured log channel with program attribution. Idempotent;
+    returns False when this jax build lacks the seam (counters then stay
+    zero — callers treat that as "no data", not an error)."""
+    global _hooks_installed
+    if _hooks_installed:
+        return True
+    try:
+        from jax._src import compiler as _jax_compiler
+        orig_miss = _jax_compiler.log_persistent_cache_miss
+        orig_hit = _jax_compiler.log_persistent_cache_hit
+    except (ImportError, AttributeError):
+        return False
+
+    def miss(module_name, cache_key, *a, **kw):
+        note_persistent_cache_miss(getattr(module_name, "name",
+                                           module_name), cache_key)
+        return orig_miss(module_name, cache_key, *a, **kw)
+
+    def hit(module_name, cache_key, *a, **kw):
+        _note_persistent_cache_hit(getattr(module_name, "name",
+                                           module_name), cache_key)
+        return orig_hit(module_name, cache_key, *a, **kw)
+
+    _jax_compiler.log_persistent_cache_miss = miss
+    _jax_compiler.log_persistent_cache_hit = hit
+    _hooks_installed = True
+    return True
 
 
 def persistent_cache_dir() -> Optional[str]:
@@ -198,5 +310,6 @@ def init_persistent_cache(path: str) -> str:
         compilation_cache.set_cache_dir(path)
     except Exception:
         pass
+    install_cache_event_hooks()
     _persistent_cache_dir = path
     return path

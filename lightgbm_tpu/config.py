@@ -480,6 +480,31 @@ class Config:
     tpu_retry_max: int = 2
     # first retry backoff in seconds; doubles on every further attempt
     tpu_retry_backoff_s: float = 0.05
+    # serving service (lightgbm_tpu/serving/): HBM budget in MB for the
+    # model registry's pool of device-resident forests. When the
+    # resident models exceed it, least-recently-USED entries are evicted
+    # (the entry just loaded is never the victim; a single model larger
+    # than the whole budget loads with a warning). 0 = unbounded
+    tpu_serve_hbm_budget_mb: float = 0.0
+    # serving latency SLO: how long the request coalescer may hold a
+    # request waiting for batch-mates before flushing to the engine.
+    # Larger values fill shape buckets better (throughput); smaller
+    # values bound tail latency
+    tpu_serve_max_batch_wait_ms: float = 2.0
+    # serving batch cap in rows: the coalescer flushes early once the
+    # queued rows for a model reach this (a bucket is full). Requests
+    # are never split across batches; one larger than the cap flushes
+    # alone and the engine chunks it internally
+    tpu_serve_max_batch_rows: int = 8192
+    # train-to-serve hot-swap: poll interval in seconds at which the
+    # serving watcher re-reads a checkpoint directory's MANIFEST.json
+    # pointer for a new version to warm and atomically swap in
+    tpu_serve_watch_interval_s: float = 0.5
+    # rows used to pre-warm a newly loaded/swapped serving engine
+    # on-device (compiles the pow2-bucket program before the first real
+    # request; swap additionally re-warms the buckets live traffic
+    # used). 0 disables warming
+    tpu_serve_warm_rows: int = 256
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

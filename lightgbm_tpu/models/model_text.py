@@ -23,7 +23,10 @@ from .tree import Tree
 # existing golden model files stay stable.
 _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
-    "tpu_fault_spec", "tpu_retry_max", "tpu_retry_backoff_s"})
+    "tpu_fault_spec", "tpu_retry_max", "tpu_retry_backoff_s",
+    "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
+    "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
+    "tpu_serve_warm_rows"})
 
 
 def _feature_infos(mappers) -> List[str]:

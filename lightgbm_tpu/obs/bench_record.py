@@ -25,7 +25,96 @@ import json
 import os
 import signal
 import sys
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class BudgetGate:
+    """Adaptive wall-budget gating for staged benchmark runs.
+
+    The r05 failure mode: per-stage gating existed but only checked
+    "budget not yet exhausted" — a stage could START with 10 s left,
+    need 500 s, and the driver's `timeout -k` killed the whole run at
+    rc=124. The gate closes that hole two ways:
+
+    * `allow(stage, est_s)` consults the REMAINING budget against an
+      estimate of the stage's cost (callers derive estimates from the
+      measured walls of earlier stages, via `wall("name")`), skipping a
+      stage that cannot finish instead of starting it;
+    * `scale_iters(base, per_iter_s)` shrinks a stage's iteration count
+      to what fits the remaining budget, so expensive stages degrade to
+      smaller measurements rather than disappearing.
+
+    A `reserve` slice of the budget (default 5%, capped 45 s) is held
+    back for finalize/flush so the complete record always lands before
+    the driver's SIGKILL. With no budget (total_s <= 0) every query
+    returns "unbounded" and nothing is ever skipped or shrunk.
+    """
+
+    def __init__(self, total_s: float, reserve_frac: float = 0.05,
+                 reserve_max_s: float = 45.0, clock=time.perf_counter,
+                 t0: Optional[float] = None) -> None:
+        self.total = max(float(total_s or 0.0), 0.0)
+        self.clock = clock
+        self.t0 = clock() if t0 is None else t0
+        self.reserve = min(self.total * reserve_frac, reserve_max_s)
+        self.stage_wall: Dict[str, float] = {}
+        self._stage_t0: Dict[str, float] = {}
+
+    # -- accounting --------------------------------------------------------
+    def elapsed(self) -> float:
+        return self.clock() - self.t0
+
+    def left(self) -> Optional[float]:
+        """Usable seconds remaining (reserve already held back), or None
+        when unbudgeted."""
+        if self.total <= 0:
+            return None
+        return self.total - self.reserve - self.elapsed()
+
+    def start(self, stage: str) -> None:
+        self._stage_t0[stage] = self.clock()
+
+    def done(self, stage: str) -> float:
+        dt = self.clock() - self._stage_t0.pop(stage, self.clock())
+        self.stage_wall[stage] = round(dt, 2)
+        return dt
+
+    def wall(self, stage: str, default: float = 0.0) -> float:
+        """Measured wall of a completed stage (the raw material for
+        estimating later stages)."""
+        return self.stage_wall.get(stage, default)
+
+    # -- decisions ---------------------------------------------------------
+    def allow(self, stage: str, est_s: float = 0.0
+              ) -> Tuple[bool, Optional[str]]:
+        """(run?, skip_reason). Skips when the budget is exhausted OR the
+        estimated stage cost no longer fits what remains."""
+        left = self.left()
+        if left is None:
+            return True, None
+        if left <= 0:
+            return False, (f"budget exhausted "
+                           f"({self.elapsed():.0f}s elapsed of "
+                           f"{self.total:.0f}s)")
+        if est_s > 0 and est_s > left:
+            return False, (f"adaptive skip: stage needs ~{est_s:.0f}s, "
+                           f"{left:.0f}s left of {self.total:.0f}s budget")
+        return True, None
+
+    def scale_iters(self, base_iters: int, per_iter_s: float,
+                    overhead_s: float = 0.0, floor: int = 1,
+                    frac: float = 0.5) -> int:
+        """Largest iteration count <= base that fits `frac` of the
+        remaining budget after `overhead_s` fixed cost (never below
+        `floor` — the stage runs small rather than lying with a zero
+        measurement; pair with `allow` to skip entirely)."""
+        left = self.left()
+        if left is None or per_iter_s <= 0:
+            return base_iters
+        usable = max(left * frac - overhead_s, 0.0)
+        fit = int(usable // per_iter_s)
+        return max(min(base_iters, fit), min(floor, base_iters))
 
 
 class BenchRecorder:

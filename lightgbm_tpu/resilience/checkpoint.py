@@ -51,6 +51,9 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_trace", "tpu_trace_dir", "tpu_compile_cache_dir",
     "snapshot_freq", "output_model", "input_model", "output_result",
     "num_threads", "verbosity",
+    "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
+    "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
+    "tpu_serve_warm_rows",
 })
 
 

@@ -245,6 +245,17 @@ class ForestEngine:
                     _ROUTE_CHUNK,
                     (self.chunk_rows // _ROUTE_CHUNK) * _ROUTE_CHUNK)
 
+    def device_bytes(self) -> int:
+        """Bytes of device memory the resident forest occupies (the
+        stacked arrays plus, on the CPU binned path, the packed-route
+        table). This is what the serving registry's HBM budget accounts
+        against — `.nbytes` on a jax array is shape metadata, no
+        transfer happens."""
+        total = sum(int(v.nbytes) for v in self._stk.values())
+        if self._route is not None:
+            total += sum(int(v.nbytes) for v in self._route.values())
+        return total
+
     def update(self, trees: List[Tree]) -> "ForestEngine":
         """Refresh the device forest for a (possibly mutated) tree list.
 
@@ -479,6 +490,7 @@ class ForestEngine:
         leaves [N, T] int32 or None). Large batches stream through
         fixed-size chunks; small ones pad to a power-of-two bucket, so any
         N inside a bucket reuses the same compiled program."""
+        from .. import compile_cache
         from ..obs import trace as obs_trace
         from ..utils import log
         planes = self._encode(X)
@@ -498,7 +510,9 @@ class ForestEngine:
                               for p in planes)
                 cc0 = self.compile_count
                 with obs_trace.span("serve.score", bucket=bucket,
-                                    rows=m):
+                                    rows=m), \
+                        compile_cache.attribution(
+                            f"serve:T{self.num_trees}:b{bucket}"):
                     if self._route is not None and not pred_leaf:
                         out = self._jit_run_routed(self._route, chunk)
                     else:

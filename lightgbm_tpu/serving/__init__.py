@@ -1,0 +1,27 @@
+"""Online serving service on top of `serve.ForestEngine`.
+
+The engine (serve/engine.py) is a single-model library: a device-
+resident stacked forest with pow2 shape buckets. This package is the
+service around it — what ROADMAP item 3 calls the production traffic
+layer:
+
+- `ModelRegistry` (registry.py): many named boosters resident at once,
+  HBM-budget LRU eviction with real byte accounting, loads from model
+  text or straight from a `resilience/` checkpoint manifest.
+- `RequestCoalescer` (coalescer.py): concurrent predict requests
+  coalesce into full shape buckets under a latency SLO
+  (`tpu_serve_max_batch_wait_ms` / `tpu_serve_max_batch_rows`).
+- `CheckpointWatcher` (watcher.py): zero-downtime hot-swap — polls the
+  checkpoint MANIFEST pointer, warms the replacement forest on-device,
+  atomically swaps the registry entry; in-flight requests finish on the
+  old forest.
+- `ServingService` (service.py): the facade the CLI `task=serve` and
+  `tools/bench_serve_traffic.py` drive.
+"""
+from .coalescer import RequestCoalescer  # noqa: F401
+from .registry import ModelEntry, ModelRegistry  # noqa: F401
+from .service import ServingService  # noqa: F401
+from .watcher import CheckpointWatcher  # noqa: F401
+
+__all__ = ["ModelEntry", "ModelRegistry", "RequestCoalescer",
+           "CheckpointWatcher", "ServingService"]

@@ -1,0 +1,204 @@
+"""Request coalescer: concurrent predict calls -> full shape buckets.
+
+`ForestEngine` pads every batch to a power-of-two bucket of at least
+`min_bucket` rows (engine.py:_bucket), so a 16-row request pays the same
+device time as a 256-row one. Per-request dispatch therefore wastes most
+of the machine at high QPS; throughput has to come from batching. This
+module is the batcher:
+
+* `submit(model, X)` enqueues the request and returns a
+  `concurrent.futures.Future` immediately — callers block only on
+  `.result()`, never on each other;
+* a background flusher drains each model's queue as ONE concatenated
+  engine call when either (a) the queued rows reach `max_batch_rows`
+  (a bucket is full — flush early, latency be damned) or (b) the oldest
+  request has waited `max_batch_wait_ms` (the latency SLO — flush
+  whatever we have);
+* a request is never split across engine calls: batches take whole
+  requests FIFO while they fit, and results are sliced back to each
+  future by row offset. An oversized single request (> max_batch_rows)
+  flushes alone — the engine chunks it internally.
+
+Errors (unknown model, bad feature width) are delivered through the
+future of every request in the failed batch; the flusher thread never
+dies. Batch-fill accounting (`rows / padded bucket rows`) is the bench's
+measure of how much of each device dispatch was real work.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import trace as obs_trace
+
+__all__ = ["RequestCoalescer"]
+
+
+class _Req:
+    __slots__ = ("X", "rows", "t_submit", "future")
+
+    def __init__(self, X: np.ndarray) -> None:
+        self.X = X
+        self.rows = int(X.shape[0])
+        self.t_submit = time.perf_counter()
+        self.future: Future = Future()
+
+
+class RequestCoalescer:
+    """SLO-aware batcher in front of a `ModelRegistry`."""
+
+    def __init__(self, registry, max_batch_wait_ms: float = 2.0,
+                 max_batch_rows: int = 8192) -> None:
+        self.registry = registry
+        self.wait_s = max(float(max_batch_wait_ms), 0.0) / 1e3
+        self.max_batch_rows = max(int(max_batch_rows), 1)
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {}
+        self._closed = False
+        self.batches = 0
+        self.requests = 0
+        self.rows = 0
+        self.padded_rows = 0            # sum of engine bucket rows dispatched
+        self.flush_full = 0             # batches flushed on a full bucket
+        self.flush_deadline = 0         # batches flushed on the wait SLO
+        self.failures = 0               # requests completed with an exception
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lgbt-serve-coalescer")
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, model: str, X) -> Future:
+        """Enqueue one predict request; the future resolves to the raw
+        margins array ([n] for single-class, [n, k] otherwise)."""
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim != 2:
+            raise ValueError(f"request matrix must be 2-D, got {X.shape}")
+        req = _Req(X)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("coalescer is closed")
+            self.requests += 1
+            self._queues.setdefault(model, deque()).append(req)
+            self._cv.notify()
+        return req.future
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the flusher. With drain (default) queued requests flush
+        first; without, they fail with a RuntimeError."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                for q in self._queues.values():
+                    for req in q:
+                        req.future.set_exception(
+                            RuntimeError("coalescer closed"))
+                    q.clear()
+            self._cv.notify()
+        self._thread.join(timeout=30)
+
+    def __enter__(self) -> "RequestCoalescer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "batches": self.batches,
+                "requests": self.requests,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "fill_ratio": round(self.rows / self.padded_rows, 4)
+                if self.padded_rows else None,
+                "rows_per_batch": round(self.rows / self.batches, 1)
+                if self.batches else None,
+                "flush_full": self.flush_full,
+                "flush_deadline": self.flush_deadline,
+                "failures": self.failures,
+            }
+
+    # -- flusher thread ----------------------------------------------------
+    def _take_batch(self, q: deque) -> List[_Req]:
+        """Whole requests FIFO while they fit max_batch_rows; at least
+        one (an oversized request flushes alone, never split)."""
+        batch = [q.popleft()]
+        total = batch[0].rows
+        while q and total + q[0].rows <= self.max_batch_rows:
+            req = q.popleft()
+            total += req.rows
+            batch.append(req)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                now = time.perf_counter()
+                ready: List = []        # (model, [reqs], reason)
+                deadline_next: Optional[float] = None
+                for model, q in self._queues.items():
+                    while q:
+                        rows = sum(r.rows for r in q)
+                        due = q[0].t_submit + self.wait_s
+                        if rows >= self.max_batch_rows:
+                            ready.append((model, self._take_batch(q),
+                                          "full"))
+                            continue
+                        if self._closed or due <= now:
+                            ready.append((model, self._take_batch(q),
+                                          "deadline"))
+                            continue
+                        deadline_next = (due if deadline_next is None
+                                         else min(deadline_next, due))
+                        break
+                if not ready:
+                    if self._closed:
+                        return
+                    timeout = (None if deadline_next is None
+                               else max(deadline_next - now, 0.0))
+                    self._cv.wait(timeout=timeout)
+                    continue
+            for model, batch, reason in ready:   # dispatch OFF the lock
+                self._flush(model, batch, reason)
+
+    def _flush(self, model: str, batch: List[_Req], reason: str) -> None:
+        rows = sum(r.rows for r in batch)
+        try:
+            entry = self.registry.acquire(model)
+            X = (batch[0].X if len(batch) == 1
+                 else np.concatenate([r.X for r in batch], axis=0))
+            eng = entry.engine
+            with obs_trace.span("serving.flush", model=model, rows=rows,
+                                requests=len(batch), reason=reason):
+                margins, _ = eng.predict(X)
+            padded = sum(eng._bucket(min(rows - lo, eng.chunk_rows))
+                         for lo in range(0, max(rows, 1), eng.chunk_rows))
+            entry.buckets.add(eng._bucket(min(rows, eng.chunk_rows)))
+            if entry.num_class <= 1:
+                margins = margins[:, 0]
+            off = 0
+            for req in batch:
+                req.future.set_result(margins[off:off + req.rows])
+                off += req.rows
+            with self._cv:
+                self.batches += 1
+                self.rows += rows
+                self.padded_rows += padded
+                if reason == "full":
+                    self.flush_full += 1
+                else:
+                    self.flush_deadline += 1
+        except BaseException as exc:  # noqa: BLE001 — delivered via futures
+            with self._cv:
+                self.failures += sum(1 for r in batch
+                                     if not r.future.done())
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)

@@ -1,0 +1,281 @@
+"""Multi-tenant model registry: many named boosters resident on device.
+
+The reference stops at one `Predictor` per process (`src/application/
+predictor.hpp`); production traffic wants N models hot at once, which on
+a TPU means N device-resident stacked forests competing for HBM. The
+registry owns that pool:
+
+* each entry wraps one `serve.ForestEngine` (mode="raw") built straight
+  from model text — or from a `resilience/` checkpoint directory, read
+  ONLY through the MANIFEST.json pointer so a concurrent trainer
+  mid-write can never hand us a torn model (see `load`);
+* byte accounting comes from `ForestEngine.device_bytes()` (the stacked
+  device arrays), and an HBM budget (`tpu_serve_hbm_budget_mb`) evicts
+  least-recently-*used* entries until the pool fits — the entry being
+  loaded is never the victim, and an oversized single model loads with
+  a warning rather than failing (the budget shapes eviction, it is not
+  an admission gate);
+* `swap()` replaces an entry atomically under the registry lock. The
+  old engine object stays alive for as long as any in-flight request
+  holds it (plain refcounting — `acquire()` hands out the entry, the
+  request keeps scoring on it even if a swap lands mid-flight), so a
+  hot-swap never fails or blocks a request.
+
+Every load/evict/swap emits a structured `log.event` and, when a ledger
+is attached, a `note` record — the same channel training uses, so a
+serving host's timeline reads like a training run's.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models.model_text import load_model_from_string
+from ..resilience.checkpoint import read_manifest
+from ..serve.engine import ForestEngine
+from ..utils import log
+
+__all__ = ["ModelEntry", "ModelRegistry", "load_checkpoint_model_text"]
+
+
+def load_checkpoint_model_text(directory: str):
+    """(model_text, version) from a resilience/ checkpoint directory, or
+    None while the directory is unreadable.
+
+    Reads ONLY via the MANIFEST.json pointer — never by globbing
+    `ckpt_*` (a concurrent trainer stages tmp dirs and retention deletes
+    old ones; directory listings are exactly the torn state the atomic
+    manifest exists to hide). A mid-write manifest (`read_manifest`
+    returns None) or a checkpoint dir swept by retention between the
+    pointer read and the file read both return None: the caller retries
+    on its next poll instead of crashing.
+    """
+    man = read_manifest(directory)
+    if man is None:
+        return None
+    latest = str(man.get("latest") or "")
+    if not latest:
+        return None
+    path = os.path.join(directory, latest, "model.txt")
+    try:
+        with open(path) as fh:
+            return fh.read(), latest
+    except OSError:
+        return None
+
+
+class ModelEntry:
+    """One resident model: its engine plus accounting the registry needs."""
+
+    __slots__ = ("name", "engine", "num_class", "num_features", "bytes",
+                 "version", "source", "loaded_at", "hits", "buckets")
+
+    def __init__(self, name: str, engine: ForestEngine, num_class: int,
+                 num_features: int, version: str, source: str) -> None:
+        self.name = name
+        self.engine = engine
+        self.num_class = num_class
+        self.num_features = num_features
+        self.bytes = engine.device_bytes()
+        self.version = version
+        self.source = source
+        self.loaded_at = time.time()
+        self.hits = 0
+        self.buckets: set = set()
+
+    def warm(self, rows: int) -> None:
+        """Trace + compile the engine's program for the pow2 bucket that
+        `rows` lands in, so the first real request finds a hot cache.
+        Also records the bucket so a replacement engine can pre-warm the
+        same working set before a swap."""
+        import numpy as np
+        rows = max(int(rows), 1)
+        X = np.zeros((min(rows, self.engine.chunk_rows),
+                      self.num_features), np.float64)
+        self.engine.predict(X)
+        self.buckets.add(self.engine._bucket(X.shape[0]))
+
+
+class ModelRegistry:
+    """Named ForestEngine pool with HBM-budget LRU eviction."""
+
+    def __init__(self, hbm_budget_mb: float = 0.0, warm_rows: int = 256,
+                 ledger=None) -> None:
+        self.hbm_budget_bytes = int(max(float(hbm_budget_mb), 0.0) * 2**20)
+        self.warm_rows = int(warm_rows)
+        self.ledger = ledger
+        self._lock = threading.RLock()
+        self._entries: Dict[str, ModelEntry] = {}
+        self._tick = 0                      # monotone LRU clock
+        self._last_used: Dict[str, int] = {}
+        self.loads = 0
+        self.swaps = 0
+        self.evictions = 0
+        self.evicted: List[str] = []        # eviction order, oldest first
+
+    # -- notes -------------------------------------------------------------
+    def _note(self, what: str, **fields) -> None:
+        log.event(f"serve_{what}", **fields)
+        if self.ledger is not None:
+            self.ledger.commit(dict({"kind": "note", "note": f"serve_{what}"},
+                                    **fields))
+
+    # -- building ----------------------------------------------------------
+    def _build_entry(self, name: str, model_str: str, version: str,
+                     source: str, warm_rows: Optional[int]) -> ModelEntry:
+        loaded = load_model_from_string(model_str)
+        trees = loaded["trees"]
+        if not trees:
+            raise ValueError(f"model {name!r} ({source}) has no trees")
+        k = int(loaded.get("num_tree_per_iteration", 1))
+        engine = ForestEngine(trees, num_class=k, mode="raw")
+        nfeat = int(loaded.get("max_feature_idx", -1)) + 1
+        if nfeat <= 0:
+            nfeat = int(max(t.split_feature.max() if t.num_leaves > 1 else 0
+                            for t in trees)) + 1
+        entry = ModelEntry(name, engine, k, nfeat, version, source)
+        rows = self.warm_rows if warm_rows is None else int(warm_rows)
+        if rows > 0:
+            entry.warm(rows)
+        return entry
+
+    # -- public API --------------------------------------------------------
+    def load(self, name: str, model_str: Optional[str] = None,
+             model_file: Optional[str] = None,
+             checkpoint_dir: Optional[str] = None,
+             warm_rows: Optional[int] = None,
+             version: str = "direct") -> ModelEntry:
+        """Load (or replace) a named model from exactly one of: a model
+        text string, a model file path, or a resilience/ checkpoint
+        directory (resolved through its manifest pointer)."""
+        srcs = [s for s in (model_str, model_file, checkpoint_dir)
+                if s is not None]
+        if len(srcs) != 1:
+            raise ValueError("load() takes exactly one of model_str / "
+                             "model_file / checkpoint_dir")
+        if model_file is not None:
+            with open(model_file) as fh:
+                model_str = fh.read()
+            source = model_file
+        elif checkpoint_dir is not None:
+            got = load_checkpoint_model_text(checkpoint_dir)
+            if got is None:
+                raise FileNotFoundError(
+                    f"no readable checkpoint manifest under {checkpoint_dir}")
+            model_str, version = got
+            source = checkpoint_dir
+        else:
+            source = "model_str"
+        entry = self._build_entry(name, model_str, version, source,
+                                  warm_rows)
+        with self._lock:
+            replacing = name in self._entries
+            self._entries[name] = entry
+            self._touch(name)
+            self.loads += 1
+            self._note("load", model=name, version=version, source=source,
+                       bytes=entry.bytes, trees=entry.engine.num_trees,
+                       replaced=replacing)
+            self._evict_over_budget(protect=name)
+        return entry
+
+    def swap(self, name: str, model_str: str, version: str = "direct",
+             source: str = "swap",
+             warm_rows: Optional[int] = None) -> ModelEntry:
+        """Zero-downtime replacement: build + warm the new engine OFF the
+        lock (no request blocks on its compiles), then atomically
+        install it. The displaced engine keeps serving any request that
+        already acquired it."""
+        old = self.get(name)
+        entry = self._build_entry(name, model_str, version, source,
+                                  warm_rows)
+        # pre-warm the buckets live traffic actually used, so the first
+        # post-swap request at those shapes hits a compiled program
+        if old is not None:
+            import numpy as np
+            for b in sorted(old.buckets - entry.buckets):
+                entry.engine.predict(
+                    np.zeros((min(b, entry.engine.chunk_rows),
+                              entry.num_features), np.float64))
+                entry.buckets.add(b)
+        with self._lock:
+            self._entries[name] = entry
+            self._touch(name)
+            self.swaps += 1
+            self._note("swap", model=name, version=version, source=source,
+                       bytes=entry.bytes, trees=entry.engine.num_trees,
+                       old_version=old.version if old is not None else None)
+            self._evict_over_budget(protect=name)
+        return entry
+
+    def acquire(self, name: str) -> ModelEntry:
+        """The entry for `name` (bumps its LRU clock). KeyError when the
+        model is absent — loaded never, or evicted."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} not resident "
+                               f"(loaded={sorted(self._entries)})")
+            entry.hits += 1
+            self._touch(name)
+            return entry
+
+    def get(self, name: str) -> Optional[ModelEntry]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.bytes for e in self._entries.values())
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "models": {n: {"bytes": e.bytes, "version": e.version,
+                               "hits": e.hits,
+                               "trees": e.engine.num_trees,
+                               "compile_count": e.engine.compile_count,
+                               "cache_hits": e.engine.cache_hits,
+                               "predict_calls": e.engine.predict_calls}
+                           for n, e in self._entries.items()},
+                "total_bytes": sum(e.bytes
+                                   for e in self._entries.values()),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "loads": self.loads,
+                "swaps": self.swaps,
+                "evictions": self.evictions,
+                "evicted": list(self.evicted),
+            }
+
+    # -- eviction ----------------------------------------------------------
+    def _touch(self, name: str) -> None:
+        self._tick += 1
+        self._last_used[name] = self._tick
+
+    def _evict_over_budget(self, protect: str) -> None:
+        """Caller holds the lock. Evict LRU entries until the pool fits
+        the budget; `protect` (the entry just installed) is exempt."""
+        if self.hbm_budget_bytes <= 0:
+            return
+        total = sum(e.bytes for e in self._entries.values())
+        while total > self.hbm_budget_bytes:
+            victims = [n for n in self._entries if n != protect]
+            if not victims:
+                log.event("serve_over_budget", model=protect,
+                          bytes=total, budget=self.hbm_budget_bytes)
+                return
+            victim = min(victims, key=lambda n: self._last_used[n])
+            gone = self._entries.pop(victim)
+            self._last_used.pop(victim, None)
+            total -= gone.bytes
+            self.evictions += 1
+            self.evicted.append(victim)
+            self._note("evict", model=victim, version=gone.version,
+                       bytes=gone.bytes, total_bytes=total,
+                       budget=self.hbm_budget_bytes)

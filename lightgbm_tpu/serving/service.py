@@ -1,0 +1,98 @@
+"""ServingService: registry + coalescer + watchers behind one facade.
+
+The piece the CLI's `task=serve` and the traffic bench drive. Configured
+through the same params surface as training (`tpu_serve_*` in
+config.py), so a serving host is launched with the familiar
+`key=value` vocabulary:
+
+    svc = ServingService(params={"tpu_serve_hbm_budget_mb": 512,
+                                 "tpu_serve_max_batch_wait_ms": 2})
+    svc.load_model("ctr", model_file="ctr.txt")
+    svc.watch("ranker", "/ckpts/ranker")       # hot-swaps on new manifests
+    margins = svc.predict("ctr", X)            # coalesced under the SLO
+
+`predict` returns RAW margins (the ForestEngine output) — objective
+transforms stay a client concern, matching the engine's own contract.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..config import Config
+from .coalescer import RequestCoalescer
+from .registry import ModelEntry, ModelRegistry
+from .watcher import CheckpointWatcher
+
+__all__ = ["ServingService"]
+
+
+class ServingService:
+    """One serving host: many resident models, one request queue."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 ledger=None) -> None:
+        cfg = Config.from_params(params or {})
+        self.config = cfg
+        self.registry = ModelRegistry(
+            hbm_budget_mb=cfg.tpu_serve_hbm_budget_mb,
+            warm_rows=cfg.tpu_serve_warm_rows,
+            ledger=ledger)
+        self.coalescer = RequestCoalescer(
+            self.registry,
+            max_batch_wait_ms=cfg.tpu_serve_max_batch_wait_ms,
+            max_batch_rows=cfg.tpu_serve_max_batch_rows)
+        self._watchers: Dict[str, CheckpointWatcher] = {}
+        self._closed = False
+
+    # -- model management --------------------------------------------------
+    def load_model(self, name: str, model_str: Optional[str] = None,
+                   model_file: Optional[str] = None,
+                   checkpoint_dir: Optional[str] = None) -> ModelEntry:
+        return self.registry.load(name, model_str=model_str,
+                                  model_file=model_file,
+                                  checkpoint_dir=checkpoint_dir)
+
+    def watch(self, name: str, checkpoint_dir: str) -> CheckpointWatcher:
+        """Serve `name` from a checkpoint directory and keep it current:
+        the initial version loads synchronously when one is readable,
+        then a poll thread hot-swaps on every new manifest version."""
+        w = self._watchers.get(name)
+        if w is not None:
+            return w
+        w = CheckpointWatcher(self.registry, name, checkpoint_dir,
+                              interval_s=self.config.tpu_serve_watch_interval_s)
+        w.poll_once()
+        self._watchers[name] = w
+        return w.start()
+
+    # -- scoring -----------------------------------------------------------
+    def predict_async(self, name: str, X):
+        """Enqueue; returns a concurrent.futures.Future of raw margins."""
+        return self.coalescer.submit(name, X)
+
+    def predict(self, name: str, X, timeout: Optional[float] = None):
+        return self.coalescer.submit(name, X).result(timeout=timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "registry": self.registry.stats(),
+            "coalescer": self.coalescer.stats(),
+            "watchers": {n: {"polls": w.polls,
+                             "versions": list(w.swapped)}
+                         for n, w in self._watchers.items()},
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._watchers.values():
+            w.stop()
+        self.coalescer.close()
+
+    def __enter__(self) -> "ServingService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

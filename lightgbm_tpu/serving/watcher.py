@@ -1,0 +1,102 @@
+"""Train-to-serve pipeline: watch a checkpoint dir, hot-swap on change.
+
+`resilience.CheckpointManager` publishes checkpoints atomically behind a
+MANIFEST.json pointer (tmp + os.replace). The watcher polls that pointer
+— and ONLY that pointer; it never globs `ckpt_*`, because directory
+listings see the trainer's staging tmp dirs and retention's deletions,
+exactly the torn state the manifest hides. Every failure mode of a
+concurrent writer (manifest mid-rewrite, checkpoint dir swept between
+the pointer read and the model read) reads as "no new version yet" and
+is retried on the next tick.
+
+On a new `latest`, the replacement forest is built and warmed on-device
+FIRST (`ModelRegistry.swap` compiles the new engine's programs for the
+buckets live traffic uses before installing it), then the registry entry
+flips atomically. In-flight requests keep the old engine alive by
+refcount; no request fails or blocks on a compile. Exactly one swap
+happens per distinct manifest version, however many poll ticks observe
+it — the ledger's `serve_swap` note count is the CI contract.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils import log
+from .registry import ModelRegistry, load_checkpoint_model_text
+
+__all__ = ["CheckpointWatcher"]
+
+
+class CheckpointWatcher:
+    """Polls one checkpoint directory and keeps one registry entry
+    current. `start()` spawns the daemon poll thread; `poll_once()` is
+    the synchronous step (tests and the service's load path drive it
+    directly)."""
+
+    def __init__(self, registry: ModelRegistry, name: str, directory: str,
+                 interval_s: float = 0.5) -> None:
+        self.registry = registry
+        self.name = name
+        self.directory = directory
+        self.interval_s = max(float(interval_s), 0.01)
+        self.polls = 0
+        self.swapped: list = []          # versions installed, in order
+        self._last_version: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- core step ---------------------------------------------------------
+    def poll_once(self) -> bool:
+        """One poll: install the manifest's latest version if it is new.
+        Returns True when a load/swap happened. Never raises on a
+        concurrently-written directory — unreadable states are retried
+        next tick."""
+        self.polls += 1
+        got = load_checkpoint_model_text(self.directory)
+        if got is None:
+            return False
+        model_str, version = got
+        if version == self._last_version:
+            return False
+        try:
+            if self.registry.get(self.name) is None:
+                entry = self.registry.load(self.name, model_str=model_str,
+                                           version=version)
+                entry.source = self.directory
+            else:
+                self.registry.swap(self.name, model_str, version=version,
+                                   source=self.directory)
+        except ValueError as exc:
+            # torn/garbage model text from a non-atomic writer: skip this
+            # version and retry the pointer next tick
+            log.event("serve_watch_bad_model", model=self.name,
+                      version=version, error=str(exc))
+            return False
+        self._last_version = version
+        self.swapped.append(version)
+        return True
+
+    # -- thread ------------------------------------------------------------
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"lgbt-serve-watch-{self.name}")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as exc:  # noqa: BLE001 — watcher must survive
+                log.event("serve_watch_error", model=self.name,
+                          error=f"{type(exc).__name__}: {exc}")
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Traffic simulation for the serving service (lightgbm_tpu/serving/).
+
+Loads >= 2 real boosters into a `ServingService` and measures, on the
+current backend:
+
+* **closed-loop throughput**, coalesced vs per-request: N client threads
+  hammer small (`rows_per_req`) requests round-robin across the resident
+  models, once through the request coalescer and once dispatching
+  `ForestEngine.predict` directly per request. The engine pads every
+  batch to a pow2 bucket of >= 256 rows, so per-request dispatch of
+  16-row requests wastes ~94% of each device call — the coalesced/direct
+  ratio is the service's whole reason to exist and is recorded as
+  `coalesced_vs_direct`.
+* **open-loop QPS sweep**: requests submitted on a fixed schedule
+  (arrival times don't wait for completions) for each target QPS;
+  records p50/p99 submit-to-result latency, achieved QPS, and batch
+  fill.
+* **hot-swap under load**: client threads keep scoring model 0 while a
+  retrained version is `registry.swap`ped in; asserts ZERO failed
+  requests and that post-swap predictions changed to the new model.
+
+Importable as `run(...)` (bench.py's serve_traffic stage and the CI
+smoke both call it) or a CLI:
+
+    JAX_PLATFORMS=cpu python tools/bench_serve_traffic.py
+
+Env overrides: BENCH_SMOKE=1 (tiny sizes), BENCH_SERVE_QPS (comma list),
+BENCH_SERVE_SECS, BENCH_SERVE_CLIENTS, BENCH_SERVE_MODELS.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _train_models(count, rows, num_features, rounds, seed=0):
+    """`count` small real boosters (plus a retrained v2 of model 0 for
+    the hot-swap leg) on shared synthetic data. Returns
+    (model_texts, v2_text, X)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(seed)
+    X = rng.rand(rows, num_features)
+    y = (X[:, 0] + 0.3 * rng.randn(rows) > 0.5).astype(float)
+    texts = []
+    for i in range(count + 1):               # last one is v2 of model 0
+        params = {"objective": "binary", "num_leaves": 15,
+                  "verbosity": -1, "seed": seed + i,
+                  "feature_fraction": 0.9, "feature_fraction_seed": i + 1}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds)
+        texts.append(bst.model_to_string())
+    return texts[:count], texts[count], X
+
+
+def _percentiles(lat_s):
+    if not lat_s:
+        return None, None
+    a = np.asarray(lat_s, np.float64) * 1e3
+    return round(float(np.percentile(a, 50)), 3), \
+        round(float(np.percentile(a, 99)), 3)
+
+
+def _closed_loop(fn, names, reqs, clients, secs):
+    """`clients` threads call fn(name, X) as fast as completions allow
+    for `secs`. Returns (requests_done, failures, wall_s, latencies)."""
+    stop = time.perf_counter() + secs
+    done = [0] * clients
+    fails = [0] * clients
+    lats = [[] for _ in range(clients)]
+
+    def worker(ci):
+        i = ci
+        while time.perf_counter() < stop:
+            name = names[i % len(names)]
+            X = reqs[i % len(reqs)]
+            t0 = time.perf_counter()
+            try:
+                fn(name, X)
+                lats[ci].append(time.perf_counter() - t0)
+                done[ci] += 1
+            except Exception:
+                fails[ci] += 1
+            i += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return sum(done), sum(fails), wall, [v for ls in lats for v in ls]
+
+
+def _open_loop(svc, names, reqs, qps, secs):
+    """Submit on the arrival schedule regardless of completions; latency
+    is submit -> future-done. Returns a per-QPS record dict."""
+    interval = 1.0 / qps
+    lats = []
+    fails = [0]
+    lock = threading.Lock()
+    futs = []
+    t_start = time.perf_counter()
+    n_target = max(int(qps * secs), 1)
+    for i in range(n_target):
+        due = t_start + i * interval
+        now = time.perf_counter()
+        if due > now:
+            time.sleep(due - now)
+        t0 = time.perf_counter()
+        fut = svc.predict_async(names[i % len(names)],
+                                reqs[i % len(reqs)])
+
+        def _done(f, t0=t0):
+            with lock:
+                if f.exception() is not None:
+                    fails[0] += 1
+                else:
+                    lats.append(time.perf_counter() - t0)
+        fut.add_done_callback(_done)
+        futs.append(fut)
+    for f in futs:
+        f.exception(timeout=600)      # wait without re-raising
+    wall = time.perf_counter() - t_start
+    p50, p99 = _percentiles(lats)
+    return {"qps_target": qps,
+            "qps_achieved": round(len(futs) / wall, 1),
+            "requests": len(futs),
+            "failures": fails[0],
+            "p50_ms": p50, "p99_ms": p99}
+
+
+def _hot_swap_under_load(svc, name, v2_text, reqs, clients, secs):
+    """Concurrent traffic on `name` while a new version swaps in."""
+    stop_at = time.perf_counter() + secs
+    counts = {"ok": 0, "fail": 0}
+    lock = threading.Lock()
+
+    def worker(ci):
+        i = ci
+        while time.perf_counter() < stop_at:
+            try:
+                svc.predict(name, reqs[i % len(reqs)], timeout=600)
+                with lock:
+                    counts["ok"] += 1
+            except Exception:
+                with lock:
+                    counts["fail"] += 1
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(secs * 0.3)            # traffic established mid-flight
+    t0 = time.perf_counter()
+    svc.registry.swap(name, v2_text, version="v2", source="traffic-bench")
+    swap_s = time.perf_counter() - t0
+    for t in threads:
+        t.join()
+    return {"requests_ok": counts["ok"], "requests_failed": counts["fail"],
+            "swap_s": round(swap_s, 3),
+            "version_after": svc.registry.acquire(name).version}
+
+
+def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
+        open_secs: float = 2.0, closed_secs: float = 2.0, clients: int = 32,
+        train_rows: int = 8000, train_rounds: int = 60,
+        num_features: int = 20, wait_ms: float = 1.0,
+        max_batch_rows: int = 2048, hbm_budget_mb: float = 0.0,
+        seed: int = 0, ledger=None, verbose: bool = False) -> dict:
+    from lightgbm_tpu.serving import ServingService
+
+    def say(msg):
+        if verbose:
+            print(f"[bench_serve] {msg}", file=sys.stderr, flush=True)
+
+    t_all = time.perf_counter()
+    texts, v2_text, X = _train_models(models, train_rows, num_features,
+                                      train_rounds, seed)
+    say(f"trained {models} models (+1 swap candidate) "
+        f"in {time.perf_counter() - t_all:.1f}s")
+
+    svc = ServingService(params={
+        "tpu_serve_max_batch_wait_ms": wait_ms,
+        "tpu_serve_max_batch_rows": max_batch_rows,
+        "tpu_serve_hbm_budget_mb": hbm_budget_mb,
+        "tpu_serve_warm_rows": 256,
+    }, ledger=ledger)
+    names = [f"m{i}" for i in range(models)]
+    try:
+        t0 = time.perf_counter()
+        for name, text in zip(names, texts):
+            svc.load_model(name, model_str=text)
+        # pre-warm every pow2 bucket the coalescer can dispatch, so the
+        # measurement sees steady-state programs (and the swap leg
+        # inherits the warmed bucket set)
+        for name in names:
+            entry = svc.registry.acquire(name)
+            b = 512
+            while b <= max_batch_rows:
+                entry.warm(b)
+                b *= 2
+        warm_s = time.perf_counter() - t0
+        say(f"load+warm: {warm_s:.1f}s "
+            f"({svc.registry.total_bytes()} bytes resident)")
+
+        rng = np.random.default_rng(seed + 99)
+        reqs = [np.ascontiguousarray(
+                    X[rng.integers(0, len(X), rows_per_req)])
+                for _ in range(64)]
+
+        # -- closed loop: direct per-request dispatch baseline -------------
+        def direct(name, Xr):
+            svc.registry.acquire(name).engine.predict(Xr)
+        n_dir, f_dir, wall_dir, lat_dir = _closed_loop(
+            direct, names, reqs, clients, closed_secs)
+        direct_rows_s = n_dir * rows_per_req / wall_dir
+        say(f"direct: {n_dir} reqs in {wall_dir:.2f}s "
+            f"({direct_rows_s:,.0f} rows/s)")
+
+        # -- closed loop: coalesced through the service --------------------
+        def coalesced(name, Xr):
+            svc.predict(name, Xr, timeout=600)
+        n_co, f_co, wall_co, lat_co = _closed_loop(
+            coalesced, names, reqs, clients, closed_secs)
+        coalesced_rows_s = n_co * rows_per_req / wall_co
+        say(f"coalesced: {n_co} reqs in {wall_co:.2f}s "
+            f"({coalesced_rows_s:,.0f} rows/s)")
+
+        # -- open-loop QPS sweep -------------------------------------------
+        sweep = []
+        for qps in qps_list:
+            rec = _open_loop(svc, names, reqs, qps, open_secs)
+            say(f"open loop qps={qps}: achieved={rec['qps_achieved']} "
+                f"p50={rec['p50_ms']}ms p99={rec['p99_ms']}ms "
+                f"failures={rec['failures']}")
+            sweep.append(rec)
+
+        # -- hot swap under load -------------------------------------------
+        swap = _hot_swap_under_load(svc, names[0], v2_text, reqs,
+                                    clients, max(closed_secs, 1.0))
+        say(f"hot swap: {swap}")
+
+        p50d, p99d = _percentiles(lat_dir)
+        p50c, p99c = _percentiles(lat_co)
+        stats = svc.stats()
+        return {
+            "serve_models": models,
+            "serve_rows_per_req": rows_per_req,
+            "serve_clients": clients,
+            "serve_warm_s": round(warm_s, 2),
+            "serve_direct_rows_s": round(direct_rows_s, 1),
+            "serve_coalesced_rows_s": round(coalesced_rows_s, 1),
+            "coalesced_vs_direct": round(
+                coalesced_rows_s / max(direct_rows_s, 1e-9), 2),
+            "serve_direct_p50_ms": p50d, "serve_direct_p99_ms": p99d,
+            "serve_coalesced_p50_ms": p50c, "serve_coalesced_p99_ms": p99c,
+            "serve_closed_failures": f_dir + f_co,
+            "serve_qps_sweep": sweep,
+            "serve_hot_swap": swap,
+            "serve_fill_ratio": stats["coalescer"]["fill_ratio"],
+            "serve_batches": stats["coalescer"]["batches"],
+            "serve_requests": stats["coalescer"]["requests"],
+            "serve_flush_full": stats["coalescer"]["flush_full"],
+            "serve_flush_deadline": stats["coalescer"]["flush_deadline"],
+            "serve_evictions": stats["registry"]["evictions"],
+            "serve_swaps": stats["registry"]["swaps"],
+            "serve_resident_bytes": stats["registry"]["total_bytes"],
+            "serve_wall_s": round(time.perf_counter() - t_all, 1),
+        }
+    finally:
+        svc.close()
+
+
+def main() -> int:
+    smoke = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
+    env = os.environ.get
+    qps = tuple(int(q) for q in
+                env("BENCH_SERVE_QPS",
+                    "25,100" if smoke else "50,200,800").split(","))
+    res = run(
+        models=int(env("BENCH_SERVE_MODELS", 2)),
+        qps_list=qps,
+        open_secs=float(env("BENCH_SERVE_SECS", 1.0 if smoke else 2.0)),
+        closed_secs=float(env("BENCH_SERVE_SECS", 1.0 if smoke else 2.0)),
+        clients=int(env("BENCH_SERVE_CLIENTS", 16 if smoke else 32)),
+        train_rows=1500 if smoke else 8000,
+        train_rounds=20 if smoke else 60,
+        verbose=True)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
