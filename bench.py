@@ -349,11 +349,16 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
     return per_iter * BASELINE_ITERS, auc, done, stats
 
 
-def run_mslr(n, f, iters, warmup, max_bin=255):
+def run_mslr(n, f, iters, warmup, max_bin=255, ab_iters=0):
     """MSLR-shaped lambdarank run. Defaults to max_bin=255 — the
     reference table's configuration (docs/Experiments.rst:110), and the
     wide-F x 255-bin shape that exercises the HBM slot-hist spill ring on
-    the aligned path (F=137 slot blocks no longer fit the VMEM budget)."""
+    the aligned path (F=137 slot blocks no longer fit the VMEM budget).
+
+    With ab_iters > 0 and the segment-fused rank kernel active, a second
+    booster runs `tpu_rank_fused=off` on the same dataset for a
+    fused-vs-bucketed per-iter A/B (per_iter_fused_ms /
+    per_iter_bucketed_ms / rank_fused_speedup in the returned info)."""
     X, y, group = synth_mslr(n, f)
     params = {
         "objective": "lambdarank",
@@ -391,6 +396,7 @@ def run_mslr(n, f, iters, warmup, max_bin=255):
         tot += q
     nd = ndcg_at(preds[:tot], y[:tot], gsub, 10)
     eng = getattr(bst._gbdt, "_aligned_eng_ref", None)
+    obj = getattr(bst._gbdt, "objective", None)
     info = {
         "max_bin": max_bin,
         "aligned": eng is not None,
@@ -398,12 +404,35 @@ def run_mslr(n, f, iters, warmup, max_bin=255):
         if eng is not None else None,
         "hist_spill": bool(getattr(eng, "hist_spill", False))
         if eng is not None else False,
+        "rank_fused": bool(getattr(obj, "rank_fused_active", False)),
+        "rank_fused_fallback_queries": int(
+            getattr(obj, "rank_fused_fallback_queries", 0)),
     }
     log(f"# mslr mb={max_bin}: bin={t_bin:.1f}s warmup({warmup})="
         f"{t_warm:.1f}s per_iter={per_iter * 1e3:.1f}ms ndcg10={nd:.5f} "
         f"aligned={'yes' if info['aligned'] else 'no'} "
         f"spill={'yes' if info['hist_spill'] else 'no'} "
-        f"fallbacks={info['fallbacks']}")
+        f"fallbacks={info['fallbacks']} "
+        f"rank_fused={'yes' if info['rank_fused'] else 'no'}")
+    if ab_iters and info["rank_fused"]:
+        # fused-vs-bucketed A/B: same dataset, bucketed grad path
+        pb = dict(params)
+        pb["tpu_rank_fused"] = "off"
+        bstb = lgb.Booster(params=pb, train_set=ds)
+        for _ in range(2):          # compile + warm the bucket ladder
+            bstb.update()
+        _sync(bstb)
+        t0 = time.perf_counter()
+        for _ in range(ab_iters):
+            bstb.update()
+        _sync(bstb)
+        per_b = (time.perf_counter() - t0) / ab_iters
+        info["per_iter_fused_ms"] = round(per_iter * 1e3, 1)
+        info["per_iter_bucketed_ms"] = round(per_b * 1e3, 1)
+        info["rank_fused_speedup"] = round(per_b / max(per_iter, 1e-9), 2)
+        log(f"# mslr A/B: fused={per_iter * 1e3:.1f}ms "
+            f"bucketed={per_b * 1e3:.1f}ms "
+            f"speedup={info['rank_fused_speedup']}x")
     return per_iter * BASELINE_ITERS, nd, info
 
 
@@ -711,7 +740,13 @@ def main() -> None:
             / max(iters // 2 + warmup, 1) * (nm / max(n, 1))
         rit = _GATE.scale_iters(rit, per_est, overhead_s=per_est * 3,
                                 floor=2)
-        mslr_s, nd, minfo = run_mslr(nm, fm, rit, 2, max_bin=255)
+        # fused-vs-bucketed A/B rides along only when its extra booster
+        # (bucket-ladder compile + a few iterations) fits the budget
+        ab = 3 if _GATE.allow("mslr_ab",
+                              est_s=per_est * 8 + (5 if smoke else 60))[0] \
+            else 0
+        mslr_s, nd, minfo = run_mslr(nm, fm, rit, 2, max_bin=255,
+                                     ab_iters=ab)
         out["ndcg10"] = round(nd, 6)
         out["mslr_500iter_s"] = round(mslr_s, 2)
         out["mslr_vs_baseline"] = round(BASELINE_MSLR_S / mslr_s, 3)
@@ -719,6 +754,13 @@ def main() -> None:
         out["mslr_aligned"] = minfo["aligned"]
         out["mslr_fallbacks"] = minfo["fallbacks"]
         out["mslr_hist_spill"] = minfo["hist_spill"]
+        out["mslr_rank_fused"] = minfo["rank_fused"]
+        out["mslr_rank_fused_fallback_queries"] = \
+            minfo["rank_fused_fallback_queries"]
+        for k in ("per_iter_fused_ms", "per_iter_bucketed_ms",
+                  "rank_fused_speedup"):
+            if k in minfo:
+                out[f"mslr_{k}"] = minfo[k]
         _stage_done("mslr", out)
 
     # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --

@@ -222,6 +222,110 @@ else
     rm -rf "$(dirname "$SERVE_DIR")"
 fi
 
+echo "== lambdarank fused smoke (5 rounds, tpu_rank_fused=on, rank_grad) =="
+RANK_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_rank"
+mkdir -p "$RANK_DIR"
+python - <<'EOF'
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(23)
+sizes = rng.randint(5, 120, 60)
+n = int(sizes.sum())
+X = rng.rand(n, 12)
+y = rng.randint(0, 5, n).astype(float)
+params = {"objective": "lambdarank", "num_leaves": 15, "verbosity": -1,
+          "metric": "none", "tpu_rank_fused": "on"}
+ds = lgb.Dataset(X, label=y, group=sizes, params=params)
+bst = lgb.Booster(params=params, train_set=ds)
+for _ in range(5):
+    bst.update()
+obj = bst._gbdt.objective
+# "on" must run the fused kernel (interpret-mode off-TPU) for EVERY
+# round with zero wholesale fallbacks and zero oversize-query leftovers
+assert obj.rank_fused_active, "tpu_rank_fused=on fell back to buckets"
+assert obj.rank_fused_fallback_queries == 0, \
+    f"unexpected leftover queries: {obj.rank_fused_fallback_queries}"
+print(f"lambdarank fused smoke: ok (5 rounds, {len(sizes)} queries, "
+      f"{n} docs, 0 fallbacks)")
+EOF
+# the device-time attribution tool must emit a schema-valid rank_grad
+# term at a (tiny, interpret-mode) MSLR-like shape
+DT255_ROWS=6000 DT255_FEATURES=4 DT255_CHUNK=256 DT255_SPLITK=2 \
+DT255_REPS=1 DT255_CHAIN=2 DT255_RANK_DOCS=3000 DT255_INTERPRET=1 \
+    python tools/device_time_255.py > "$RANK_DIR/device_time.json"
+RANK_SMOKE_DIR="$RANK_DIR" python - <<'EOF'
+import json
+import os
+
+with open(os.path.join(os.environ["RANK_SMOKE_DIR"],
+                       "device_time.json")) as fh:
+    rec = json.loads(fh.read().strip().splitlines()[-1])
+terms = rec["terms_ms"]
+for key in ("hist", "route", "flush", "split_eval", "rank_grad"):
+    assert isinstance(terms.get(key), (int, float)), (key, terms)
+assert terms["rank_grad"] > 0, terms
+assert rec["rank_fused"] is True, rec
+assert rec["rank_docs"] > 0 and rec["rank_queries"] > 0, rec
+print(f"rank_grad attribution: ok ({terms['rank_grad']}ms over "
+      f"{rec['rank_docs']} docs, fused={rec['rank_fused']})")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "device-time artifact kept under $RANK_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$RANK_DIR")"
+fi
+
+echo "== bench kill smoke (SIGTERM mid-stage -> last line still parses) =="
+KILL_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_benchkill"
+mkdir -p "$KILL_DIR"
+# simulated driver timeout: start a smoke bench, wait for the recorder's
+# first cumulative emit (a stage-start line), then SIGTERM it mid-stage
+BENCH_SMOKE=1 BENCH_OUT="$KILL_DIR/bench.json" \
+    python bench.py > "$KILL_DIR/bench.log" 2>&1 &
+BENCH_PID=$!
+for _ in $(seq 1 240); do
+    grep -q '^{' "$KILL_DIR/bench.log" 2>/dev/null && break
+    sleep 0.25
+done
+kill -TERM "$BENCH_PID" 2>/dev/null || true
+set +e
+wait "$BENCH_PID"
+BRC=$?
+set -e
+# 143 = died of SIGTERM (the recorder's trap re-raises); 75 would mean a
+# checkpointing path claimed it; anything else is a real failure
+if [ "$BRC" -ne 143 ] && [ "$BRC" -ne 137 ] && [ "$BRC" -ne 75 ]; then
+    echo "FAIL: killed bench exited $BRC (want SIGTERM death)" >&2
+    tail -20 "$KILL_DIR/bench.log" >&2
+    exit 1
+fi
+BENCH_KILL_DIR="$KILL_DIR" python - <<'EOF'
+import json
+import os
+
+path = os.path.join(os.environ["BENCH_KILL_DIR"], "bench.log")
+with open(path) as fh:
+    lines = [ln.strip() for ln in fh if ln.strip()]
+# the contract the driver relies on: the LAST stdout line of a killed
+# run is always the cumulative summary JSON
+rec = json.loads(lines[-1])
+assert rec.get("stage_reached"), rec
+assert rec.get("incomplete") is True, rec
+assert isinstance(rec.get("stages_done"), list), rec
+side = os.path.join(os.environ["BENCH_KILL_DIR"], "bench.json")
+srec = json.load(open(side))
+assert srec.get("stage_reached"), srec
+print(f"bench kill smoke: ok (killed in stage "
+      f"{rec['stage_reached']!r}, last line + sidecar both parse)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "bench-kill artifacts kept under $KILL_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$KILL_DIR")"
+fi
+
 echo "== tests ($MODE tier) =="
 if [ "$MODE" = "full" ]; then
     python -m pytest tests/ -q

@@ -409,6 +409,32 @@ class Config:
     # aligned-pipeline kernels (ops/aligned.py) and the standalone
     # pallas histogram (ops/pallas_hist.py)
     tpu_hist_subbin: str = "auto"
+    # segment-fused lambdarank gradient kernel (ops/pallas_rank.py): one
+    # Pallas program streams query segments (CSR doc offsets packed into
+    # fixed-size row tiles) through VMEM and computes rank positions,
+    # sigmoid pair factors (bf16 compute, f32 accumulation), NDCG deltas
+    # and per-doc lambda/hessian in place — the [Q, S, S] pair tensors of
+    # the bucketed path never exist in HBM, and ONE compiled program
+    # replaces the per-bucket-size program ladder. "auto": fused when a
+    # TPU is attached, bucketed otherwise; "on": fused everywhere
+    # (interpret-mode kernel on CPU — slow, tests/CI only); "off": the
+    # bucketed pair-tensor path. Queries longer than tpu_rank_tile fall
+    # back to the bucketed path per query; a kernel build failure falls
+    # back wholesale (warned + logged as a rank_fused event)
+    tpu_rank_fused: str = "auto"
+    # docs per fused lambdarank tile (multiple of 128). Larger tiles
+    # amortize grid overhead but pay more masked cross-query pair work
+    # inside each subtile band; 512 fits MSLR's 40..200-doc queries with
+    # low waste. Queries longer than this are handled by the bucketed
+    # fallback path
+    tpu_rank_tile: int = 512
+    # quantize the fused kernel's sigmoid *input* to this many bins over
+    # the reference table range [-50, 50] — the semantics of the
+    # reference's quantized sigmoid lookup table (rank_objective.hpp:71,
+    # 2/(1+exp(2*sigmoid*x)) tabulated at bin left edges). 0 = exact
+    # sigmoid (default: on TPU the exp is cheaper than a gather, so the
+    # LUT exists for reference-parity experiments, not speed)
+    tpu_rank_sigmoid_bins: int = 0
     # VMEM budget (MB) for the aligned move pass's [K+1]-slot histogram
     # store. When the store fits, it stays VMEM-resident for the whole
     # pass (fastest); when it does not (wide-F x 255-bin shapes, e.g.

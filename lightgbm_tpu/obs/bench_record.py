@@ -134,9 +134,12 @@ class BenchRecorder:
     # -- stage protocol ----------------------------------------------------
     def start_stage(self, name: str) -> None:
         self.out["stage_reached"] = name
-        # sidecar-only flush (no stdout line): even an untrappable
-        # SIGKILL mid-stage leaves the stage name on disk
-        self.flush_file()
+        # full emit (stdout + sidecar) at stage START, not only at stage
+        # end: a run SIGKILLed mid-stage — including during a long
+        # C-level XLA compile, where Python signal traps never run —
+        # still has a parseable cumulative record as its last stdout
+        # line (plus the stage name on disk)
+        self.emit()
 
     def stage_done(self, name: str) -> None:
         if name not in self.out["stages_done"]:

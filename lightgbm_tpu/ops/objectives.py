@@ -692,6 +692,8 @@ class CrossEntropyLambda(ObjectiveFunction):
 # ---------------------------------------------------------------------------
 # lambdarank (src/objective/rank_objective.hpp)
 # ---------------------------------------------------------------------------
+from . import pallas_rank
+from .pallas_hist import pallas_available
 from .ranking import (bucket_queries, dcg_discounts, max_dcg_at_k)
 
 
@@ -719,10 +721,47 @@ class LambdarankNDCG(ObjectiveFunction):
             m = max_dcg_at_k(k, self._label_np[lo:hi].astype(np.int64),
                              label_gain)
             inv[q] = 1.0 / m if m > 0 else 0.0
-        self._buckets = bucket_queries(self.query_boundaries)
         self._inv_max_dcg = inv
         self._grad_fns: Dict[int, Callable] = {}
         self.num_data = num_data
+        # --- segment-fused Pallas gradient path (ops/pallas_rank.py).
+        # Mode resolution: "off" -> bucketed; "auto" -> fused iff a real
+        # TPU is attached; "on" -> fused everywhere (interpret-mode
+        # kernel on CPU, for tests/CI). Queries longer than
+        # tpu_rank_tile stay on the bucketed path; a kernel failure at
+        # first dispatch falls back wholesale (see get_gradients).
+        self._fused_pack = None
+        self._fused_dev = None
+        self._fused_fn = None
+        self._fused_interpret = False
+        self.rank_fused_active = False
+        self.rank_fused_fallback_queries = 0
+        include = None
+        mode = str(getattr(self.cfg, "tpu_rank_fused", "auto")).lower()
+        on_tpu = pallas_available()
+        if pallas_rank.HAS_PALLAS and (
+                mode == "on" or (mode == "auto" and on_tpu)):
+            tile = max(pallas_rank.SUBTILE,
+                       int(getattr(self.cfg, "tpu_rank_tile", 512)))
+            tile = -(-tile // pallas_rank.SUBTILE) * pallas_rank.SUBTILE
+            pack = pallas_rank.pack_query_tiles(self.query_boundaries,
+                                                tile)
+            if pack.num_tiles > 0:
+                self._fused_pack = pack
+                self._fused_interpret = not on_tpu
+                self.rank_fused_active = True
+                self.rank_fused_fallback_queries = int(
+                    pack.leftover.sum())
+                from ..utils import log
+                log.event("rank_fused", tiles=pack.num_tiles,
+                          tile=pack.tile, band=int(pack.band),
+                          fill_pct=round(100.0 * pack.fill, 1),
+                          fallback_queries=self.rank_fused_fallback_queries,
+                          interpret=self._fused_interpret)
+                # only oversize leftovers keep a bucket ladder
+                include = pack.leftover
+        self._buckets = bucket_queries(self.query_boundaries,
+                                       include=include)
 
     def _make_grad_fn(self, size: int):
         sig = float(self.cfg.sigmoid)
@@ -794,19 +833,95 @@ class LambdarankNDCG(ObjectiveFunction):
         tabs = getattr(self, "_bucket_dev", None)
         if tabs is None:
             tabs = {}
-            for size, (qids, doc_idx, mask) in self._buckets.items():
-                tabs[size] = (
-                    jnp.asarray(doc_idx),
-                    jnp.asarray(self._label_np[doc_idx].astype(np.int32)),
-                    jnp.asarray(mask),
-                    jnp.asarray(self._inv_max_dcg[qids], jnp.float32))
+            # the first get_gradients call may run under an outer jit
+            # trace (the device-time harness chains it in a fori_loop);
+            # without the eval guard these "constants" would be cached
+            # as that trace's tracers and leak into the next one
+            with jax.ensure_compile_time_eval():
+                for size, (qids, doc_idx, mask) in self._buckets.items():
+                    tabs[size] = (
+                        jnp.asarray(doc_idx),
+                        jnp.asarray(
+                            self._label_np[doc_idx].astype(np.int32)),
+                        jnp.asarray(mask),
+                        jnp.asarray(self._inv_max_dcg[qids],
+                                    jnp.float32))
             self._bucket_dev = tabs
         return tabs
 
+    def _fused_dev_tables(self):
+        """Device-resident per-slot constants for the fused kernel
+        (doc ids, query ids, label gains, labels, inv max DCG, discount
+        table) — uploaded once, like `_bucket_dev_tables`."""
+        tabs = self._fused_dev
+        if tabs is None:
+            pack = self._fused_pack
+            real = pack.qid >= 0
+            lab = np.where(
+                real, self._label_np[pack.doc_idx].astype(np.int32), -1)
+            gain = np.where(
+                real,
+                self.label_gain[np.clip(lab, 0, None)].astype(np.float32),
+                0.0).astype(np.float32)
+            inv = np.where(
+                real,
+                self._inv_max_dcg[np.clip(pack.qid, 0, None)],
+                0.0).astype(np.float32)
+            # see _bucket_dev_tables: cached constants must be concrete
+            # even when the first call runs under an outer trace
+            with jax.ensure_compile_time_eval():
+                tabs = (jnp.asarray(pack.doc_idx),
+                        jnp.asarray(pack.qid),
+                        jnp.asarray(gain), jnp.asarray(lab),
+                        jnp.asarray(inv),
+                        jnp.asarray(
+                            pallas_rank.discount_table(pack.tile)))
+            self._fused_dev = tabs
+        return tabs
+
+    def _fused_grads(self, score):
+        pack = self._fused_pack
+        fn = self._fused_fn
+        if fn is None:
+            lut = int(getattr(self.cfg, "tpu_rank_sigmoid_bins", 0))
+            fn = compile_cache.program(
+                pallas_rank.fused_program_key(
+                    self.num_data, pack, float(self.cfg.sigmoid), lut,
+                    self._fused_interpret),
+                lambda: pallas_rank.make_fused_grad_fn(
+                    self.num_data, pack.num_tiles, pack.tile,
+                    int(pack.band), float(self.cfg.sigmoid), lut,
+                    interpret=self._fused_interpret))
+            self._fused_fn = fn
+        return fn(score, *self._fused_dev_tables())
+
+    def _fused_disable(self, err):
+        """Kernel build/dispatch failed: fall back to the bucketed path
+        wholesale (rebuild the full ladder) and keep training."""
+        from ..utils import log
+        log.warning(f"fused lambdarank kernel failed "
+                    f"({type(err).__name__}: {err}); falling back to "
+                    f"the bucketed path")
+        log.event("rank_fused", fallback="kernel_error",
+                  error=type(err).__name__)
+        self.rank_fused_active = False
+        self._fused_pack = None
+        self._fused_dev = None
+        self._fused_fn = None
+        self._buckets = bucket_queries(self.query_boundaries)
+        self._bucket_dev = None
+
     def get_gradients(self, scores):
         score = scores[0]
-        g = jnp.zeros_like(score)
-        h = jnp.zeros_like(score)
+        g = h = None
+        if self.rank_fused_active:
+            try:
+                g, h = self._fused_grads(score)
+            except Exception as err:  # noqa: BLE001 - wholesale fallback
+                self._fused_disable(err)
+        if g is None:
+            g = jnp.zeros_like(score)
+            h = jnp.zeros_like(score)
         for size, (didx, labels_q, mask, inv) in \
                 self._bucket_dev_tables().items():
             fn = self._grad_fns.get(size)

@@ -9,9 +9,11 @@ fixed-shape device programs instead of a ragged host loop.
 from __future__ import annotations
 
 import math
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from ..utils import log
 
 
 def dcg_discounts(n: int) -> np.ndarray:
@@ -43,22 +45,33 @@ def dcg_at_k(k: int, labels: np.ndarray, scores: np.ndarray,
     return float(np.sum(label_gain[labels[order[:k]]] * disc))
 
 
-def bucket_queries(query_boundaries: np.ndarray, min_size: int = 8
+def bucket_queries(query_boundaries: np.ndarray, min_size: int = 8,
+                   include: Optional[np.ndarray] = None
                    ) -> Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Group queries by padded (power-of-two) document count.
 
     Returns {padded_size: (query_ids [Q], doc_idx [Q, S] int32,
     mask [Q, S] bool)} where doc_idx are global row ids (pads point at the
-    query's first doc and are masked out).
+    query's first doc and are masked out). `include` (bool per query)
+    restricts bucketing to a subset — the fused-kernel path uses it to
+    route only its oversize leftovers here.
+
+    Emits a `rank_buckets` log event (docs-per-bucket histogram and
+    padded-pair waste %) at dataset construct time so ladder re-tuning
+    is data-driven instead of hand-derived each bench round.
     """
     qb = np.asarray(query_boundaries, np.int64)
     counts = np.diff(qb)
     # pairwise work is O(S^2), so ladder spacing is pure padding waste
-    # vs compiled-program count. Up to 256 docs — where real ranking
-    # sets concentrate (MSLR queries are ~40..200 docs) — the ladder
-    # runs QUARTER steps (pow2 + 1.25x/1.5x/1.75x): a 161-doc query
-    # pads to 192 not 256 (1.78x fewer pairs), a 130-doc one to 160
-    # not 192, for at most ~9 extra compiled programs. Above 256 the
+    # vs compiled-program count. From 32 to 256 docs — where real
+    # ranking sets concentrate (MSLR queries are ~40..200 docs) — the
+    # ladder runs QUARTER steps (pow2 + 1.25x/1.5x/1.75x): a 161-doc
+    # query pads to 192 not 256 (1.78x fewer pairs), a 130-doc one to
+    # 160 not 192, for at most ~9 extra compiled programs. BELOW 32 the
+    # steps are pow2 only: the quarter rungs at 10/12/14/20/24/28 held
+    # <2% of MSLR's pair work yet 6 of the ladder's ~15 compiled
+    # programs — measured cold-start XLA compiles for nothing (the r05
+    # mb=255 warm-up cliff; see ROUND7_NOTES.md). Above 256 the
     # ladder falls back to ~sqrt(2) spacing (pow2 + 1.5x midpoints) —
     # giant queries are rare enough that halved pair tensors no longer
     # pay for the extra compiles.
@@ -66,20 +79,37 @@ def bucket_queries(query_boundaries: np.ndarray, min_size: int = 8
     s = max(8, min_size)
     while s <= (1 << 20):
         ladder.append(s)
-        if s <= 256:
+        if 32 <= s <= 256:
             ladder.extend([s + s // 4, s + s // 2, s + 3 * s // 4])
-        else:
+        elif s > 256:
             ladder.append(s + s // 2)
         s <<= 1
     ladder = sorted(set(ladder))
     sizes = {}
     for q, c in enumerate(counts):
+        if include is not None and not include[q]:
+            continue
         c = max(int(c), 1)
         need = max(c, min_size)
         s = next((x for x in ladder if x >= need), None)
         if s is None:       # beyond the ladder: plain pow2 rounding
             s = 1 << int(math.ceil(math.log2(need)))
         sizes.setdefault(s, []).append(q)
+    if sizes:
+        real_pairs = sum(int(counts[q]) ** 2
+                         for qs in sizes.values() for q in qs)
+        padded_pairs = sum(s * s * len(qs) for s, qs in sizes.items())
+        log.event(
+            "rank_buckets",
+            queries=sum(len(qs) for qs in sizes.values()),
+            docs=int(sum(counts[q] for qs in sizes.values() for q in qs)),
+            buckets={str(s): [len(qs),
+                              int(sum(counts[q] for q in qs))]
+                     for s, qs in sorted(sizes.items())},
+            pair_waste_pct=round(
+                100.0 * (padded_pairs - real_pairs) / max(real_pairs, 1),
+                1),
+            subset=include is not None)
     out = {}
     for s, qids in sizes.items():
         qids = np.asarray(qids, np.int64)

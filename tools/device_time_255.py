@@ -15,15 +15,21 @@ lives there; this file only builds the 255-bin term closures:
               (through the HBM DMA ring when the layout spills)
   split_eval  the jitted split finder over a [SPLITK, F, B, 3] batch
               (the per-round changed-children evaluation)
+  rank_grad   the lambdarank gradient pass over an MSLR-like query
+              distribution (segment-fused Pallas kernel when available,
+              bucketed pair tensors otherwise; "rank_fused" in the JSON
+              says which was measured)
 
 Emits ONE JSON line on stdout:
   {"n": ..., "features": ..., "max_bin": 255, "chunk": ...,
-   "subbin": ..., "spill": ...,
+   "subbin": ..., "spill": ..., "rank_docs": ..., "rank_queries": ...,
+   "rank_fused": ...,
    "terms_ms": {"hist": ..., "route": ..., "flush": ...,
-                "split_eval": ...}}
+                "split_eval": ..., "rank_grad": ...}}
 
 Env knobs: DT255_ROWS (default 10_500_000), DT255_FEATURES (28),
 DT255_CHUNK (1024), DT255_SPLITK (16), DT255_REPS (3), DT255_CHAIN (8),
+DT255_RANK_DOCS (2_270_000; 0 skips the rank_grad term),
 DT255_INTERPRET=1 (CPU interpret-mode kernels — the -m slow smoke test
 in tests/test_subbin_spill.py runs a tiny shape this way).
 """
@@ -169,6 +175,45 @@ def main():
         return f
 
     tt.measure("split_eval", mk_split, hist_b)
+
+    # ---- rank_grad: lambdarank gradients at MSLR-like queries ---------
+    RD = int(os.environ.get("DT255_RANK_DOCS", 2_270_000))
+    if RD > 0:
+        from lightgbm_tpu.ops.objectives import LambdarankNDCG
+        from lightgbm_tpu.ops.pallas_hist import pallas_available
+        qsizes = []
+        tot = 0
+        while tot < RD:                 # MSLR concentrates at 40..200
+            c = int(rng.randint(40, 201))
+            qsizes.append(c)
+            tot += c
+        qb = np.concatenate([[0], np.cumsum(qsizes)]).astype(np.int64)
+        nd = int(qb[-1])
+        rcfg = Config()
+        rcfg.objective = "lambdarank"
+        rcfg.label_gain = [float((1 << i) - 1) for i in range(31)]
+        rcfg.tpu_rank_fused = \
+            "on" if (pallas_available() or INTERPRET) else "off"
+        rlab = rng.randint(0, 5, nd).astype(np.float64)
+        obj = LambdarankNDCG(rcfg)
+        obj.init(type("M", (), {"query_boundaries": qb, "label": rlab,
+                                "weight": None})(), nd)
+        tt.out["rank_docs"] = nd
+        tt.out["rank_queries"] = len(qsizes)
+        tt.out["rank_fused"] = bool(obj.rank_fused_active)
+        sc0 = jnp.asarray(rng.randn(nd).astype(np.float32))
+
+        def mk_rank(k):
+            @jax.jit
+            def f(s):
+                def body(i, s):
+                    g, h = obj.get_gradients(s[None, :])
+                    # data dependence so the loop body survives DCE
+                    return s + g[0] * 1e-9 + h[0] * 1e-12
+                return lax.fori_loop(0, k, body, s)
+            return f
+
+        tt.measure("rank_grad", mk_rank, sc0, rows=nd)
 
     print(json.dumps(tt.out), flush=True)
 

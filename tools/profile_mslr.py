@@ -2,8 +2,19 @@
 """MSLR-shape lambdarank per-iter timing, aligned vs fused builder.
 
 python tools/profile_mslr.py [n] [max_bin] [iters] [mode]
-env: LSPEC (tpu_level_spec), TPU_CHUNK
+env: LSPEC (tpu_level_spec), TPU_CHUNK, RANK_FUSED (tpu_rank_fused:
+auto/on/off), PM_CHAIN / PM_REPS (rank_grad chained-k protocol)
+
+Prints the human per_iter line, then ONE JSON line:
+  {"n": ..., "features": 137, "max_bin": ..., "mode": ...,
+   "per_iter_ms": ..., "fallbacks": ..., "rank_fused": ...,
+   "rank_fused_fallback_queries": ...,
+   "terms_ms": {"rank_grad": ...}}
+so the MSLR per-iter budget (hist/route/rank_grad/split, the first
+three from tools/device_time_255.py at the same shape) is attributed
+in machine-readable form.
 """
+import json
 import os
 import sys
 import time
@@ -52,6 +63,8 @@ def main():
         params["tpu_level_spec"] = float(os.environ["LSPEC"])
     if os.environ.get("TPU_CHUNK"):
         params["tpu_chunk"] = int(os.environ["TPU_CHUNK"])
+    if os.environ.get("RANK_FUSED"):
+        params["tpu_rank_fused"] = os.environ["RANK_FUSED"]
     t0 = time.perf_counter()
     ds = lgb.Dataset(X, label=y, group=group, params=params).construct()
     print(f"# bin {time.perf_counter()-t0:.1f}s", flush=True)
@@ -78,6 +91,40 @@ def main():
     dt = (time.perf_counter() - t0) / ITERS
     fb = getattr(gb, "_aligned_fallback_count", 0)
     print(f"per_iter={dt*1e3:.1f}ms fallbacks={fb}", flush=True)
+
+    # ---- rank_grad device-time attribution (chained-k protocol) -------
+    from jax import lax
+    from lightgbm_tpu.obs.devicetime import TermTimer
+    obj = gb.objective
+    tt = TermTimer(
+        {"n": N, "features": F, "max_bin": MB, "mode": MODE,
+         "per_iter_ms": round(dt * 1e3, 1), "fallbacks": int(fb),
+         "rank_fused": bool(getattr(obj, "rank_fused_active", False)),
+         "rank_fused_fallback_queries": int(
+             getattr(obj, "rank_fused_fallback_queries", 0))},
+        chain=int(os.environ.get("PM_CHAIN", 4)),
+        reps=int(os.environ.get("PM_REPS", 2)),
+        log=lambda m: print(m, file=sys.stderr, flush=True))
+    if eng is not None:
+        sc0 = eng.row_scores_dev()
+    else:
+        import jax.numpy as jnp
+        sc0 = jnp.asarray(
+            np.asarray(gb.train_score.score).reshape(-1)[:N])
+
+    def mk_rank(k):
+        import jax as _jax
+
+        @_jax.jit
+        def f(s):
+            def body(i, s):
+                g, h = obj.get_gradients(s[None, :])
+                return s + g[0] * 1e-9 + h[0] * 1e-12
+            return lax.fori_loop(0, k, body, s)
+        return f
+
+    tt.measure("rank_grad", mk_rank, sc0, rows=N)
+    print(json.dumps(tt.out), flush=True)
 
 
 if __name__ == "__main__":
