@@ -148,9 +148,10 @@ def _stage(name):
 
 
 def _stage_done(name, out):
-    """Stage completed: record its wall + compile-cache misses, re-emit
-    the cumulative record, flush the sidecar, and append a stage record
-    to the obs ledger when one is attached."""
+    """Stage completed: record its wall + compile-cache misses + an HBM
+    accountant snapshot, re-emit the cumulative record, flush the
+    sidecar, and append a stage record to the obs ledger when one is
+    attached."""
     wall = _GATE.done(name)
     out.setdefault("stage_wall_s", {})[name] = round(wall, 2)
     miss = compile_cache.persistent_cache_events()["misses"] \
@@ -158,6 +159,23 @@ def _stage_done(name, out):
     # which stage recompiled despite the warm cache — each miss also
     # emitted a compile_cache_miss [Event] naming the exact program
     out.setdefault("compile_cache_misses", {})[name] = miss
+    try:
+        from lightgbm_tpu.obs import memory as obs_memory
+        snap = obs_memory.snapshot()
+        mb = 1 << 20
+        hbm = {"claimed_mb": round(snap["claimed_bytes"] / mb, 1),
+               # process-lifetime high-water mark as of this stage's end
+               # (backend peak where the platform reports one, else the
+               # claimed-bytes peak over snapshots)
+               "peak_mb": round(snap["peak_bytes"] / mb, 1)}
+        if snap["device_bytes_in_use"] is not None:
+            hbm["in_use_mb"] = round(snap["device_bytes_in_use"] / mb, 1)
+        if snap["hbm_unattributed_bytes"] is not None:
+            hbm["unattributed_mb"] = round(
+                snap["hbm_unattributed_bytes"] / mb, 1)
+        out.setdefault("hbm_by_stage", {})[name] = hbm
+    except Exception:
+        pass  # accounting must never void a bench record
     if _REC is not None:
         _REC.stage_done(name)
     else:

@@ -222,6 +222,159 @@ else
     rm -rf "$(dirname "$SERVE_DIR")"
 fi
 
+echo "== metrics scrape smoke (task=serve + live /metrics endpoint) =="
+MET_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_metrics"
+mkdir -p "$MET_DIR"
+LGBT_MET_DIR="$MET_DIR" python - <<'EOF'
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+mdir = os.environ["LGBT_MET_DIR"]
+rng = np.random.RandomState(5)
+X = rng.rand(900, 6).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(900) > 0.5).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                lgb.Dataset(X, label=y), num_boost_round=10)
+bst.save_model(os.path.join(mdir, "model.txt"))
+np.savetxt(os.path.join(mdir, "rows.tsv"),
+           np.column_stack([y[:500], X[:500]]), delimiter="\t", fmt="%.6g")
+EOF
+MET_PORT=$(python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)
+# serve the model, score rows through the coalescer, then hold the
+# process up so the scrape sees a LIVE endpoint mid-serve
+python -m lightgbm_tpu task=serve "input_model=m=$MET_DIR/model.txt" \
+    "data=$MET_DIR/rows.tsv" "output_result=$MET_DIR/preds.txt" \
+    "tpu_serve_metrics_port=$MET_PORT" tpu_serve_hold_s=60 \
+    verbosity=-1 > "$MET_DIR/serve.log" 2>&1 &
+SERVE_PID=$!
+for _ in $(seq 1 240); do
+    grep -q '^Holding' "$MET_DIR/serve.log" 2>/dev/null && break
+    sleep 0.25
+done
+LGBT_MET_DIR="$MET_DIR" LGBT_MET_PORT="$MET_PORT" python - <<'EOF'
+import json
+import os
+import urllib.request
+
+port = os.environ["LGBT_MET_PORT"]
+base = f"http://127.0.0.1:{port}"
+with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/plain"), \
+        resp.headers["Content-Type"]
+    text = resp.read().decode()
+
+
+def series(name):
+    vals = [ln.split()[-1] for ln in text.splitlines()
+            if ln.startswith(name) and not ln.startswith("#")]
+    assert vals, f"{name} missing from /metrics:\n{text[:2000]}"
+    return float(vals[0])
+
+
+# request counters moved during the data pass
+assert series("serve_requests_total") > 0
+assert series("serve_rows_total") >= 500
+assert series("serve_batches_total") > 0
+# latency histogram: bucket series + interpolated percentiles per model
+assert 'serve_request_latency_ms_bucket{model="m",le="+Inf"}' in text
+assert series('serve_request_latency_ms_count{model="m"}') > 0
+p50 = series('serve_request_latency_ms_p50{model="m"}')
+p99 = series('serve_request_latency_ms_p99{model="m"}')
+assert 0 < p50 <= p99, (p50, p99)
+assert 0 < series("serve_batch_fill_ratio") <= 1.0
+# HBM accountant gauges (claimed/peak always publish; bytes_in_use is
+# backend-dependent and absent on the CPU CI backend)
+assert series("serve_model_loads_total") >= 1
+assert series("serve_model_evictions_total") >= 0   # registered, live
+assert series("serve_model_swaps_total") >= 0
+assert series("hbm_claimed_total_bytes") > 0
+assert series("hbm_peak_claimed_bytes") >= series("hbm_claimed_total_bytes")
+assert 'hbm_claimed_bytes{owner="serving/registry_pool"}' in text
+
+# the JSON view carries the same registry under a versioned schema
+with urllib.request.urlopen(base + "/metrics.json", timeout=10) as resp:
+    doc = json.load(resp)
+assert doc["schema"] == 1, doc.get("schema")
+assert doc["metrics"]["counters"]["serve_requests_total"] > 0
+assert doc["memory"]["claimed_bytes"] > 0
+assert "hbm_unattributed_bytes" in doc["memory"]
+hist = doc["metrics"]["histograms"]['serve_request_latency_ms{model="m"}']
+assert hist["count"] > 0 and hist["p99_ms"] is not None
+print(f"metrics scrape smoke: ok ({int(series('serve_requests_total'))} "
+      f"requests, p50={p50:.3g}ms p99={p99:.3g}ms, "
+      f"claimed={int(series('hbm_claimed_total_bytes'))}B)")
+EOF
+kill -INT "$SERVE_PID" 2>/dev/null || true
+set +e
+wait "$SERVE_PID"
+SERVE_RC=$?
+set -e
+if [ "$SERVE_RC" -ne 0 ]; then
+    echo "FAIL: held serve process exited $SERVE_RC (want clean 0)" >&2
+    cat "$MET_DIR/serve.log" >&2
+    exit 1
+fi
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "metrics artifacts kept under $MET_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$MET_DIR")"
+fi
+
+echo "== bench_compare sentinel (history trajectory + regression gate) =="
+BC_DIR="$(mktemp -d)"
+# the committed BENCH series must read as improved with zero regressions
+# (r05 is a known driver-timeout record: excluded as incomplete)
+python tools/bench_compare.py BENCH_r01.json BENCH_r02.json \
+    BENCH_r03.json BENCH_r04.json BENCH_r05.json --gate \
+    --out "$BC_DIR/history.json" > /dev/null
+LGBT_BC_DIR="$BC_DIR" python - <<'EOF'
+import json
+import os
+
+v = json.load(open(os.path.join(os.environ["LGBT_BC_DIR"], "history.json")))
+assert v["overall"] == "improved", v["overall"]
+assert v["counts"]["regressed"] == 0, v["counts"]
+assert v["incomplete"] == ["r05"], v["incomplete"]
+assert v["metrics"]["vs_baseline"]["verdict"] == "improved"
+assert v["metrics"]["mslr_vs_baseline"]["verdict"] == "neutral"
+print("bench_compare history: ok (higgs improved, mslr flat, r05 "
+      "excluded)")
+EOF
+# an injected regression must fail the gate with a nonzero exit
+LGBT_BC_DIR="$BC_DIR" python - <<'EOF'
+import json
+import os
+
+d = os.environ["LGBT_BC_DIR"]
+base = {"metric": "higgs_synth_500iter_s", "unit": "s",
+        "value": 300.0, "vs_baseline": 0.8, "auc": 0.7375}
+json.dump(base, open(os.path.join(d, "a.json"), "w"))
+json.dump(dict(base, value=390.0), open(os.path.join(d, "b.json"), "w"))
+EOF
+set +e
+python tools/bench_compare.py "$BC_DIR/a.json" "$BC_DIR/b.json" --gate \
+    > "$BC_DIR/gate.log" 2>&1
+BC_RC=$?
+set -e
+if [ "$BC_RC" -eq 0 ]; then
+    echo "FAIL: bench_compare --gate passed an injected 30% regression" >&2
+    cat "$BC_DIR/gate.log" >&2
+    exit 1
+fi
+echo "bench_compare gate: ok (injected regression exits $BC_RC)"
+rm -rf "$BC_DIR"
+
 echo "== lambdarank fused smoke (5 rounds, tpu_rank_fused=on, rank_grad) =="
 RANK_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_rank"
 mkdir -p "$RANK_DIR"

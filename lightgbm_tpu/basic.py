@@ -425,6 +425,21 @@ class Booster:
         on; None otherwise."""
         return getattr(self._gbdt, "telemetry", None) or self._telemetry
 
+    def metrics_snapshot(self):
+        """Live metrics + HBM accounting snapshot — the API twin of the
+        serving /metrics endpoint, parked like `bst.telemetry`: the
+        registry is process-wide, so the snapshot survives the
+        engine.train round-trip onto the fresh booster. Keys:
+        ``metrics`` (obs/metrics.py versioned snapshot: counters,
+        gauges, histograms with p50/p99) and ``memory`` (obs/memory.py
+        owner reconciliation). Counters are zero until something enables
+        the plane (`tpu_metrics`, a serving exporter, or
+        `obs.metrics.enable()`)."""
+        from .obs import memory as obs_memory
+        from .obs import metrics as obs_metrics
+        return {"metrics": obs_metrics.snapshot(),
+                "memory": obs_memory.snapshot()}
+
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()

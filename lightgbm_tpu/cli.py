@@ -149,10 +149,21 @@ class Application:
         out = cfg.output_model or "LightGBM_model.txt"
         booster.save_model(out)
         if cfg.tpu_trace:
+            from . import compile_cache
             from .obs import trace as obs_trace
             tdir = cfg.tpu_trace_dir or "lgbt_trace"
-            dump = obs_trace.write(os.path.join(tdir,
-                                                "trace_summary.json"))
+            # fold the compile-cache story in next to the spans: total
+            # persistent-cache hits/misses, which attributed program
+            # each miss blamed, and the process trace count — the
+            # warm-up forensics that used to need a bench run
+            dump = obs_trace.write(
+                os.path.join(tdir, "trace_summary.json"),
+                extra={"compile_cache": {
+                    **compile_cache.persistent_cache_events(),
+                    "miss_by_program": compile_cache.miss_attribution(),
+                    "traces": compile_cache.trace_count(),
+                    "cache_dir": compile_cache.persistent_cache_dir(),
+                }})
             print(f"Telemetry: span summary at {dump}")
         if getattr(booster, "_preempted", False):
             from .resilience import EXIT_PREEMPTED
@@ -277,6 +288,19 @@ class Application:
                       f"{target!r}. Results saved to {out}")
             print("Serving stats: "
                   + json.dumps(svc.stats(), sort_keys=True, default=str))
+            if svc.exporter is not None:
+                print(f"Metrics: {svc.exporter.url}/metrics "
+                      f"(Prometheus) and /metrics.json", flush=True)
+            if cfg.tpu_serve_hold_s > 0:
+                # scrape/hot-swap window: hold the service up, exit
+                # early and cleanly on Ctrl-C / SIGTERM
+                import time as _time
+                print(f"Holding for {cfg.tpu_serve_hold_s:g}s "
+                      f"(tpu_serve_hold_s)...", flush=True)
+                try:
+                    _time.sleep(cfg.tpu_serve_hold_s)
+                except KeyboardInterrupt:
+                    pass
         finally:
             svc.close()
         return 0

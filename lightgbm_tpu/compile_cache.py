@@ -197,6 +197,7 @@ class HashableFn:
 _persistent_cache_dir: Optional[str] = None
 _pcache_hits = 0
 _pcache_misses = 0
+_miss_by_program: Dict[str, int] = {}
 _hooks_installed = False
 
 
@@ -204,6 +205,15 @@ def persistent_cache_events() -> Dict[str, int]:
     """Counts of persistent-compile-cache hits/misses observed by the
     jax hooks this process (zeros until `install_cache_event_hooks`)."""
     return {"hits": _pcache_hits, "misses": _pcache_misses}
+
+
+def miss_attribution() -> Dict[str, int]:
+    """Persistent-cache miss counts keyed by the attribution tag active
+    when each miss fired (`program_tag` for registry programs, explicit
+    `attribution()` labels elsewhere, "unattributed" when none). This is
+    the aggregate the CLI folds into trace_summary.json — the per-event
+    stream already lands on the structured log channel."""
+    return dict(_miss_by_program)
 
 
 def note_persistent_cache_miss(module_name: str, cache_key: str = "") -> None:
@@ -215,6 +225,8 @@ def note_persistent_cache_miss(module_name: str, cache_key: str = "") -> None:
     program hit)."""
     global _pcache_misses
     _pcache_misses += 1
+    tag = current_attribution() or "unattributed"
+    _miss_by_program[tag] = _miss_by_program.get(tag, 0) + 1
     from .utils import log
     log.event("compile_cache_miss", module=str(module_name),
               key=str(cache_key)[:20], program=current_attribution())

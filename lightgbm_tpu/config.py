@@ -531,6 +531,25 @@ class Config:
     # request; swap additionally re-warms the buckets live traffic
     # used). 0 disables warming
     tpu_serve_warm_rows: int = 256
+    # live metrics plane (obs/metrics.py + obs/memory.py): feed the
+    # process-wide registry from the training round loop — rounds,
+    # retraces, aligned fallbacks, retry events, per-round latency
+    # histogram — and refresh the HBM accountant gauges. Off by default:
+    # the round loop then pays one attribute check and adds zero device
+    # fences. Read via bst.metrics_snapshot(); serving exposes the same
+    # registry over HTTP (tpu_serve_metrics_port)
+    tpu_metrics: bool = False
+    # serving /metrics exporter: TCP port for the ServingService's HTTP
+    # endpoint — Prometheus text at /metrics (request counters,
+    # coalescer batch fill, LRU evictions, per-model latency histograms
+    # with p50/p99, live + peak HBM gauges) and the same snapshot as
+    # JSON at /metrics.json. Binds 127.0.0.1. 0 disables the exporter
+    tpu_serve_metrics_port: int = 0
+    # keep the task=serve process alive this many seconds after loading
+    # and scoring finish (0 = exit immediately): the window in which
+    # scrapers hit the /metrics exporter and checkpoint watchers may
+    # hot-swap. SIGINT/SIGTERM end the hold early and exit cleanly
+    tpu_serve_hold_s: float = 0.0
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

@@ -528,6 +528,14 @@ class Dataset:
         """Exclusive Feature Bundling (reference dataset.cpp:68-213): the
         binned matrix shrinks to one storage column per bundle; the
         per-feature view is reconstructed on device (io/bundling.py)."""
+        # every construct path funnels through here once bins are final:
+        # register the binned matrix with the HBM accountant (the
+        # closure reads live state, so the post-bundle shrink is what a
+        # snapshot reports)
+        from ..obs import memory as obs_memory
+        obs_memory.track(
+            "dataset/bins", self,
+            lambda d: 0 if d.bins is None else int(d.bins.nbytes))
         from .bundling import apply_bundles, plan_bundles
         if reference is not None:
             # valid sets reuse the training set's bundling so binned

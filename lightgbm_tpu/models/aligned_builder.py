@@ -288,6 +288,10 @@ class AlignedEngine:
         else:
             self.rec = jnp.asarray(rec_all)
             self.cnts = jnp.asarray(cnts_all)
+        from ..obs import memory as obs_memory
+        obs_memory.track(
+            "train/aligned_records", self,
+            lambda e: int(e.rec.nbytes) + int(e.cnts.nbytes))
         self._pgrad = objective.point_grad_fn()
         if self._pgrad is not None:
             # hash/eq by signature: the point-grad closure rides into
@@ -411,6 +415,13 @@ class AlignedEngine:
         subbin, spill, slot_bytes, spill_budget = hist_layout(
             cfg, self.ncols, _bh, K)
         self.hist_subbin, self.hist_spill = subbin, spill
+        if spill:
+            # the move kernel's [K+1]-slot hist store lives in HBM while
+            # spilling; its size is static per program, so the owner
+            # claim is a constant
+            from ..obs import memory as obs_memory
+            obs_memory.track("train/hist_spill_store", self,
+                             lambda e, b=(K + 1) * slot_bytes: b)
         if spill and not getattr(self, "_spill_logged", False):
             self._spill_logged = True
             log.info(

@@ -325,6 +325,11 @@ class DeviceTreeLearner:
     def bins_dev(self) -> jax.Array:
         if self._bins_dev is None:
             self._bins_dev = jnp.asarray(self.ds.bins)
+            from ..obs import memory as obs_memory
+            obs_memory.track(
+                "train/bins_dev", self,
+                lambda lr: 0 if lr._bins_dev is None
+                else int(lr._bins_dev.nbytes))
         return self._bins_dev
 
     # ------------------------------------------------------------------

@@ -227,6 +227,23 @@ class GBDT:
             tdir = cfg.tpu_trace_dir or "lgbt_trace"
             obs_trace.enable(tdir)
             self.telemetry = obs_ledger.RoundLedger.for_training(tdir, cfg)
+        # live metrics plane (obs/metrics.py): None when off — the same
+        # single-branch discipline as telemetry, and the metered path
+        # never fences (host wall + counter deltas only)
+        self._metrics = None
+        self._obs_trees_seen = 0
+        if cfg.tpu_metrics:
+            from ..obs import metrics as obs_metrics
+            obs_metrics.enable()
+            self._metrics = obs_metrics.train_instruments()
+        # HBM accountant (obs/memory.py): the training score buffers are
+        # a named owner; registration is once-per-booster and read only
+        # at snapshot time
+        from ..obs import memory as obs_memory
+        obs_memory.track(
+            "train/scores", self,
+            lambda g: int(g.train_score.score.nbytes)
+            + sum(int(su.score.nbytes) for su in g.valid_scores))
         # resilience (resilience/): deterministic fault plan (param/env)
         # and the retry wrapper around device dispatches. None/False on
         # the default path — _dispatch_device is then a plain call
@@ -339,7 +356,9 @@ class GBDT:
         ledger record (see _train_one_iter_traced); off, this is a
         single None check."""
         if self.telemetry is None:
-            return self._train_one_iter_impl(grad, hess)
+            if self._metrics is None:
+                return self._train_one_iter_impl(grad, hess)
+            return self._train_one_iter_metered(grad, hess)
         return self._train_one_iter_traced(grad, hess)
 
     def _dispatch_device(self, what: str, fn, *args):
@@ -411,6 +430,44 @@ class GBDT:
             rec["gate_notes"] = notes
             rec["hist_spill"] = any("spill" in n.lower() for n in notes)
         self.telemetry.commit(rec)
+        if self._metrics is not None:
+            self._note_round_metrics(rec["wall_ms"], rec["traces"],
+                                     rec["fallbacks"])
+        return finished
+
+    def _note_round_metrics(self, wall_ms: float, traces: int,
+                            fallbacks: int) -> None:
+        """Feed one completed round into the live metrics registry."""
+        m = self._metrics
+        m.rounds.inc()
+        m.round_ms.observe(wall_ms)
+        if traces > 0:
+            m.retraces.inc(traces)
+        if fallbacks > 0:
+            m.fallbacks.inc(fallbacks)
+        trees = len(self.models)
+        if trees > self._obs_trees_seen:
+            m.trees.inc(trees - self._obs_trees_seen)
+        self._obs_trees_seen = trees
+
+    def _train_one_iter_metered(self, grad, hess) -> bool:
+        """Metrics-only round wrapper (`tpu_metrics` without
+        `tpu_trace`): host wall + trace/fallback counter deltas, NO
+        fence — wall_ms here is dispatch wall, not device wall, which is
+        what keeps the enabled overhead in the sub-percent range."""
+        import time as _time
+
+        from ..compile_cache import trace_count
+        traces0 = trace_count()
+        t0 = _time.perf_counter()
+        finished = self._train_one_iter_impl(grad, hess)
+        wall_ms = (_time.perf_counter() - t0) * 1e3
+        eng = getattr(self, "_aligned_eng_ref", None)
+        fb = int(getattr(eng, "fallbacks", 0) or 0) if eng is not None \
+            else 0
+        self._note_round_metrics(wall_ms, trace_count() - traces0,
+                                 fb - self._obs_fallbacks_seen)
+        self._obs_fallbacks_seen = fb
         return finished
 
     def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None,

@@ -8,6 +8,8 @@ branch. Enable with the `tpu_trace` / `tpu_trace_dir` params (both enter
 `compile_cache.config_signature`, so toggling tracing retraces rather
 than silently reusing a differently-fenced program).
 """
-from . import bench_record, devicetime, ledger, trace  # noqa: F401
+from . import (bench_record, devicetime, ledger, memory,  # noqa: F401
+               metrics, trace)
 
-__all__ = ["bench_record", "devicetime", "ledger", "trace"]
+__all__ = ["bench_record", "devicetime", "ledger", "memory", "metrics",
+           "trace"]

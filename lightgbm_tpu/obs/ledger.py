@@ -19,8 +19,10 @@ Record kinds:
   (the round record is already flushed by then; the eval record carries
   the same ``round`` index so readers can join them).
 
-Readers: ``read_ledger(path)`` -> list of dicts; ``validate_record``
-raises on schema violations (used by tests and the CI telemetry smoke).
+Readers: ``read_ledger(path)`` -> list of dicts (a ``LedgerRows`` whose
+``torn_tail`` flag marks a dropped torn final line after a mid-flush
+kill); ``validate_record`` raises on schema violations (used by tests
+and the CI telemetry smoke).
 """
 from __future__ import annotations
 
@@ -59,14 +61,35 @@ def validate_record(rec: Dict[str, Any]) -> None:
         raise ValueError("eval record missing round index")
 
 
-def read_ledger(path: str) -> List[Dict[str, Any]]:
-    """Parse a ledger JSONL file; raises on any malformed line."""
-    out = []
+class LedgerRows(List[Dict[str, Any]]):
+    """`read_ledger` result: a plain list of records plus a `torn_tail`
+    flag — True when the file's LAST line was a torn partial record
+    (SIGKILL mid-flush) and was dropped rather than parsed."""
+
+    torn_tail: bool = False
+
+
+def read_ledger(path: str) -> LedgerRows:
+    """Parse a ledger JSONL file.
+
+    A process killed mid-`flush` leaves a torn final line; every record
+    before it is intact (one record per line, flushed per commit), so
+    the torn tail is dropped and reported via `rows.torn_tail` instead
+    of making the whole ledger unreadable. A malformed line anywhere
+    BUT the tail still raises — that is corruption, not a crash
+    artifact."""
+    out = LedgerRows()
     with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+        lines = [ln.strip() for ln in fh]
+    nonempty = [(i, ln) for i, ln in enumerate(lines) if ln]
+    for pos, (_i, line) in enumerate(nonempty):
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if pos == len(nonempty) - 1:
+                out.torn_tail = True
+                break
+            raise
     return out
 
 

@@ -1,0 +1,206 @@
+"""HBM accountant: one ledger of who owns device memory, reconciled
+against the backend's own numbers.
+
+Before this module each subsystem tracked bytes privately — the serving
+registry budget reads `ForestEngine.device_bytes()`, the aligned engine
+knows its record buffers, the spill ring logs its slot bytes once — and
+nothing summed them or compared the sum to what the device ACTUALLY
+holds. The accountant closes that loop:
+
+* owners self-register with `track(name, obj, fn)`: a weakref to the
+  owning object plus a bytes-callback run only at snapshot time. A
+  garbage-collected owner silently drops off the ledger (no unregister
+  bookkeeping at del time), and registration is a dict insert — cheap
+  enough to do unconditionally at object construction, so enabling the
+  metrics plane late still sees every live owner.
+* `aggregate=True` owners (the serving registry pool, which SUMS its
+  entries' engines) are reported but excluded from `claimed_total` —
+  otherwise pool + per-engine owners would double-count.
+* `snapshot()` reconciles: claimed per owner, claimed total, the
+  backend's `jax.local_devices()[0].memory_stats()` where the platform
+  provides one (TPU does; CPU returns nothing and the device fields are
+  None), and the residual `hbm_unattributed_bytes = bytes_in_use -
+  claimed_total` — a growing residual is the leak/under-accounting
+  signal. Live + peak gauges land in the metrics registry on every
+  snapshot, so a /metrics scrape is always current.
+
+Zero-overhead discipline: nothing here touches jax except inside
+`device_memory_stats()` at snapshot time; the hot paths never call into
+this module per round/request.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["track", "untrack", "owners_bytes", "claimed_total",
+           "device_memory_stats", "snapshot", "peaks", "reset"]
+
+_lock = threading.Lock()
+# name -> (weakref-or-None, fn, aggregate). fn takes the live object (or
+# no argument when obj was registered as None) and returns bytes.
+_owners: Dict[str, Any] = {}
+_peak_claimed = 0
+_peak_in_use = 0
+
+
+def track(name: str, obj: Optional[Any], fn: Callable[..., int],
+          aggregate: bool = False) -> str:
+    """Register `obj` as a named HBM owner; returns the ledger name
+    actually used (a `#k` suffix disambiguates same-named live owners).
+    Re-tracking the same (name, obj) pair replaces the callback instead
+    of duplicating the row. `obj=None` registers a static owner whose
+    `fn()` takes no argument (e.g. a fixed-size kernel store)."""
+    ref = None if obj is None else weakref.ref(obj)
+    with _lock:
+        use = name
+        k = 1
+        while use in _owners:
+            old_ref, _fn, _agg = _owners[use]
+            old = old_ref() if old_ref is not None else None
+            if old_ref is None and obj is None:
+                break                      # static owner: replace
+            if old is obj and obj is not None:
+                break                      # same object: replace
+            if old_ref is not None and old is None:
+                break                      # dead row: reuse the slot
+            k += 1
+            use = f"{name}#{k}"
+        _owners[use] = (ref, fn, aggregate)
+        return use
+
+
+def untrack(name: str) -> None:
+    with _lock:
+        _owners.pop(name, None)
+
+
+def reset() -> None:
+    """Drop every owner and both peaks (tests)."""
+    global _peak_claimed, _peak_in_use
+    with _lock:
+        _owners.clear()
+        _peak_claimed = 0
+        _peak_in_use = 0
+
+
+def _read_owner(ref, fn) -> Optional[int]:
+    """Bytes for one row; None when the owner is dead or the callback
+    fails (a snapshot must never raise out of a scrape)."""
+    if ref is None:
+        args = ()
+    else:
+        obj = ref()
+        if obj is None:
+            return None
+        args = (obj,)
+    try:
+        return int(fn(*args))
+    except Exception:
+        return None
+
+
+def owners_bytes() -> Dict[str, Dict[str, Any]]:
+    """{name: {"bytes": int, "aggregate": bool}} for every live owner;
+    dead rows are pruned as a side effect."""
+    with _lock:
+        items = list(_owners.items())
+    out: Dict[str, Dict[str, Any]] = {}
+    dead = []
+    for name, (ref, fn, agg) in items:
+        b = _read_owner(ref, fn)
+        if b is None and ref is not None and ref() is None:
+            dead.append(name)
+            continue
+        out[name] = {"bytes": 0 if b is None else b, "aggregate": agg}
+    if dead:
+        with _lock:
+            for name in dead:
+                _owners.pop(name, None)
+    return out
+
+
+def claimed_total(owners: Optional[Dict[str, Dict[str, Any]]] = None) -> int:
+    owners = owners_bytes() if owners is None else owners
+    return sum(o["bytes"] for o in owners.values() if not o["aggregate"])
+
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """The first local device's memory_stats() with int-valued keys, or
+    None when the backend has no allocator stats (CPU) or jax is not
+    importable yet. Never raises."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        return {k: int(v) for k, v in stats.items()
+                if isinstance(v, (int, float))}
+    except Exception:
+        return None
+
+
+def peaks() -> Dict[str, int]:
+    return {"claimed": _peak_claimed, "in_use": _peak_in_use}
+
+
+def snapshot() -> Dict[str, Any]:
+    """Reconcile and publish: per-owner bytes, claimed total, backend
+    bytes-in-use/peak where available, the unattributed residual, and
+    process-lifetime peaks (high-water marks over snapshots taken).
+    Also refreshes the `hbm_*` gauges in the metrics registry."""
+    global _peak_claimed, _peak_in_use
+    owners = owners_bytes()
+    claimed = claimed_total(owners)
+    dev = device_memory_stats()
+    in_use = dev.get("bytes_in_use") if dev else None
+    dev_peak = dev.get("peak_bytes_in_use") if dev else None
+    unattributed = (in_use - claimed) if in_use is not None else None
+    with _lock:
+        _peak_claimed = max(_peak_claimed, claimed)
+        if in_use is not None:
+            _peak_in_use = max(_peak_in_use, in_use)
+        if dev_peak is not None:
+            _peak_in_use = max(_peak_in_use, dev_peak)
+        peak_claimed, peak_in_use = _peak_claimed, _peak_in_use
+    _publish_gauges(owners, claimed, in_use, unattributed,
+                    peak_claimed, peak_in_use)
+    return {
+        "schema": 1,
+        "owners": {n: o["bytes"] for n, o in owners.items()},
+        "aggregates": sorted(n for n, o in owners.items()
+                             if o["aggregate"]),
+        "claimed_bytes": claimed,
+        "peak_claimed_bytes": peak_claimed,
+        "device_bytes_in_use": in_use,
+        "device_peak_bytes_in_use": dev_peak,
+        "peak_bytes": peak_in_use or peak_claimed,
+        "hbm_unattributed_bytes": unattributed,
+    }
+
+
+def _publish_gauges(owners, claimed, in_use, unattributed,
+                    peak_claimed, peak_in_use) -> None:
+    from . import metrics as obs_metrics
+    r = obs_metrics.registry()
+    fam = r.gauge("hbm_claimed_bytes",
+                  "device bytes claimed by a registered owner",
+                  labelnames=("owner",))
+    for name, o in owners.items():
+        fam.labels(owner=name).set(o["bytes"])
+    r.gauge("hbm_claimed_total_bytes",
+            "sum of non-aggregate owner claims").set(claimed)
+    r.gauge("hbm_peak_claimed_bytes",
+            "high-water mark of claimed bytes over snapshots"
+            ).set(peak_claimed)
+    if in_use is not None:
+        r.gauge("hbm_bytes_in_use",
+                "backend allocator bytes_in_use").set(in_use)
+        r.gauge("hbm_peak_bytes_in_use",
+                "backend allocator peak bytes_in_use").set(peak_in_use)
+    if unattributed is not None:
+        r.gauge("hbm_unattributed_bytes",
+                "bytes_in_use minus claimed (under-accounting residual)"
+                ).set(unattributed)

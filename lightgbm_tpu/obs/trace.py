@@ -188,9 +188,12 @@ def fence(x):
     return _block(x)
 
 
-def write(path: str) -> str:
+def write(path: str, extra: Optional[Dict[str, Any]] = None) -> str:
     """Dump all completed spans (plus a summary header) to `path` as one
-    JSON document — the CLI's end-of-training trace dump."""
+    JSON document — the CLI's end-of-training trace dump. `extra` keys
+    merge into the top level (the CLI folds compile-cache hit/miss
+    totals and per-program miss attribution in here, so warm-up
+    forensics don't require a bench run)."""
     by_name: Dict[str, Dict[str, float]] = {}
     for s in _spans:
         agg = by_name.setdefault(s["name"], {"count": 0, "total_ms": 0.0})
@@ -198,6 +201,8 @@ def write(path: str) -> str:
         agg["total_ms"] = round(agg["total_ms"] + s["dur_ms"], 4)
     doc = {"pid": os.getpid(), "fences": fence_count,
            "summary": by_name, "spans": _spans}
+    if extra:
+        doc.update(extra)
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(doc, fh, sort_keys=True, default=str)

@@ -53,7 +53,8 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "num_threads", "verbosity",
     "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
     "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
-    "tpu_serve_warm_rows",
+    "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
+    "tpu_serve_hold_s",
 })
 
 

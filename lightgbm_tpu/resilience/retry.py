@@ -52,6 +52,8 @@ def call_with_retry(fn: Callable, args: Tuple[Any, ...], *, what: str,
                 plan.raise_transient(n, what)
             out = fn(*args)
             if attempt > 0:
+                from ..obs import metrics as obs_metrics
+                obs_metrics.note_retry_event("recovered")
                 log.event("retry_recovered", what=what, dispatch=n,
                           attempts=attempt)
                 if telemetry is not None:
@@ -61,13 +63,16 @@ def call_with_retry(fn: Callable, args: Tuple[Any, ...], *, what: str,
                                       "attempts": attempt})
             return out
         except Exception as exc:
+            from ..obs import metrics as obs_metrics
             if not is_transient(exc) or attempt >= max_retries:
                 if attempt > 0:
+                    obs_metrics.note_retry_event("exhausted")
                     log.event("retry_exhausted", what=what, dispatch=n,
                               attempts=attempt, error=str(exc)[:200])
                 raise
             delay = backoff_s * (2.0 ** attempt)
             attempt += 1
+            obs_metrics.note_retry_event("retry")
             log.warning(f"transient error in {what} (dispatch {n}), "
                         f"retry {attempt}/{max_retries} in {delay:.3f}s: "
                         f"{exc}")

@@ -205,6 +205,12 @@ class ForestEngine:
         self._jit_run_routed = jax.jit(self._run_routed)
         self._sharded_cache: dict = {}
         self._install(trees)
+        # HBM accountant owner: one row per live engine, read via
+        # device_bytes() (shape metadata only) at snapshot time; a
+        # GC'd engine drops off the ledger automatically
+        from ..obs import memory as obs_memory
+        obs_memory.track("serve/forest", self,
+                         lambda e: e.device_bytes())
 
     # -- forest cache ------------------------------------------------------
     def _install(self, trees: List[Tree]) -> None:

@@ -17,11 +17,15 @@ layer:
   old forest.
 - `ServingService` (service.py): the facade the CLI `task=serve` and
   `tools/bench_serve_traffic.py` drive.
+- `MetricsExporter` (exporter.py): the `/metrics` + `/metrics.json`
+  HTTP endpoint over the process metrics registry (obs/metrics.py) and
+  HBM accountant (obs/memory.py); wired by `tpu_serve_metrics_port`.
 """
 from .coalescer import RequestCoalescer  # noqa: F401
+from .exporter import MetricsExporter  # noqa: F401
 from .registry import ModelEntry, ModelRegistry  # noqa: F401
 from .service import ServingService  # noqa: F401
 from .watcher import CheckpointWatcher  # noqa: F401
 
 __all__ = ["ModelEntry", "ModelRegistry", "RequestCoalescer",
-           "CheckpointWatcher", "ServingService"]
+           "CheckpointWatcher", "ServingService", "MetricsExporter"]

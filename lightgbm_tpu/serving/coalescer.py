@@ -67,6 +67,11 @@ class RequestCoalescer:
         self.flush_full = 0             # batches flushed on a full bucket
         self.flush_deadline = 0         # batches flushed on the wait SLO
         self.failures = 0               # requests completed with an exception
+        # live metrics handle: resolved once, None when the plane is off
+        # (submit/flush then pay one attribute check each)
+        from ..obs import metrics as obs_metrics
+        self._metrics = (obs_metrics.serving_instruments()
+                         if obs_metrics.enabled() else None)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="lgbt-serve-coalescer")
         self._thread.start()
@@ -85,6 +90,8 @@ class RequestCoalescer:
             self.requests += 1
             self._queues.setdefault(model, deque()).append(req)
             self._cv.notify()
+        if self._metrics is not None:
+            self._metrics.requests.inc()
         return req.future
 
     def close(self, drain: bool = True) -> None:
@@ -184,6 +191,7 @@ class RequestCoalescer:
             if entry.num_class <= 1:
                 margins = margins[:, 0]
             off = 0
+            t_done = time.perf_counter()
             for req in batch:
                 req.future.set_result(margins[off:off + req.rows])
                 off += req.rows
@@ -195,10 +203,23 @@ class RequestCoalescer:
                     self.flush_full += 1
                 else:
                     self.flush_deadline += 1
+            m = self._metrics
+            if m is not None:
+                m.batches.labels(reason=reason).inc()
+                m.rows.inc(rows)
+                m.padded_rows.inc(padded)
+                if self.padded_rows:
+                    m.fill.set(self.rows / self.padded_rows)
+                lat = m.latency.labels(model=model)
+                for req in batch:
+                    lat.observe((t_done - req.t_submit) * 1e3)
         except BaseException as exc:  # noqa: BLE001 — delivered via futures
             with self._cv:
                 self.failures += sum(1 for r in batch
                                      if not r.future.done())
+            if self._metrics is not None:
+                self._metrics.failures.inc(
+                    sum(1 for r in batch if not r.future.done()))
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(exc)

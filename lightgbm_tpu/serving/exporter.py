@@ -1,0 +1,110 @@
+"""MetricsExporter: the serving host's scrape endpoint.
+
+A stdlib `ThreadingHTTPServer` (no new dependencies) bound to
+127.0.0.1 serving two views of the same process-wide registry
+(`obs/metrics.py`):
+
+* ``GET /metrics``       — Prometheus text exposition format v0.0.4,
+  including per-model latency histograms with interpolated _p50/_p99
+  series and the HBM accountant gauges;
+* ``GET /metrics.json``  — the versioned snapshot dict (registry +
+  memory reconciliation) for tooling that prefers JSON.
+
+Every scrape refreshes the HBM accountant first (`obs.memory.snapshot`
+reads owner callbacks + backend memory_stats at that moment), so the
+gauges are live, not last-event stale. Scrapes run on the HTTP server's
+threads and never touch the request path.
+
+Wired by `ServingService` when ``tpu_serve_metrics_port`` is nonzero;
+``port=0`` here binds an OS-assigned ephemeral port (the CLI param's 0
+means "off" — tests use 0 to avoid port races and read ``.port``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+
+__all__ = ["MetricsExporter"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    exporter: "MetricsExporter" = None  # set per server instance
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = self.exporter.render_prometheus().encode()
+                ctype = PROM_CONTENT_TYPE
+            elif path == "/metrics.json":
+                body = json.dumps(self.exporter.render_json(),
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
+            elif path in ("/", "/healthz"):
+                body = b"ok\n"
+                ctype = "text/plain"
+            else:
+                self.send_error(404)
+                return
+        except Exception as exc:  # a broken callback must not kill scrapes
+            self.send_error(500, str(exc)[:100])
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+
+class MetricsExporter:
+    """HTTP scrape endpoint over the process metrics registry."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        obs_metrics.enable()
+        handler = type("_BoundHandler", (_Handler,), {"exporter": self})
+        self._server = ThreadingHTTPServer((host, int(port)), handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"lgbt-metrics-exporter:{self.port}")
+        self._thread.start()
+
+    # -- rendering (also the testing seam — no HTTP needed) ---------------
+    def render_prometheus(self) -> str:
+        obs_memory.snapshot()          # refresh hbm_* gauges first
+        return obs_metrics.to_prometheus()
+
+    def render_json(self) -> Dict[str, Any]:
+        return {"schema": obs_metrics.SCHEMA_VERSION,
+                "metrics": obs_metrics.snapshot(),
+                "memory": obs_memory.snapshot()}
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -114,6 +114,16 @@ class ModelRegistry:
         self.swaps = 0
         self.evictions = 0
         self.evicted: List[str] = []        # eviction order, oldest first
+        # live metrics plane: the pool is an AGGREGATE HBM owner (its
+        # engines each have their own serve/forest row — summing both
+        # would double count), and load/swap/evict feed counters when
+        # the plane is on (resolved once here, None otherwise)
+        from ..obs import memory as obs_memory
+        from ..obs import metrics as obs_metrics
+        obs_memory.track("serving/registry_pool", self,
+                         lambda r: r.total_bytes(), aggregate=True)
+        self._metrics = (obs_metrics.serving_instruments()
+                         if obs_metrics.enabled() else None)
 
     # -- notes -------------------------------------------------------------
     def _note(self, what: str, **fields) -> None:
@@ -175,6 +185,8 @@ class ModelRegistry:
             self._entries[name] = entry
             self._touch(name)
             self.loads += 1
+            if self._metrics is not None:
+                self._metrics.loads.inc()
             self._note("load", model=name, version=version, source=source,
                        bytes=entry.bytes, trees=entry.engine.num_trees,
                        replaced=replacing)
@@ -204,6 +216,8 @@ class ModelRegistry:
             self._entries[name] = entry
             self._touch(name)
             self.swaps += 1
+            if self._metrics is not None:
+                self._metrics.swaps.inc()
             self._note("swap", model=name, version=version, source=source,
                        bytes=entry.bytes, trees=entry.engine.num_trees,
                        old_version=old.version if old is not None else None)
@@ -275,6 +289,8 @@ class ModelRegistry:
             self._last_used.pop(victim, None)
             total -= gone.bytes
             self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.evictions.inc()
             self.evicted.append(victim)
             self._note("evict", model=victim, version=gone.version,
                        bytes=gone.bytes, total_bytes=total,

@@ -33,6 +33,12 @@ class ServingService:
                  ledger=None) -> None:
         cfg = Config.from_params(params or {})
         self.config = cfg
+        # metrics must be on BEFORE the registry/coalescer resolve their
+        # instrument handles (they bind once at construction)
+        self.exporter = None
+        if cfg.tpu_serve_metrics_port or cfg.tpu_metrics:
+            from ..obs import metrics as obs_metrics
+            obs_metrics.enable()
         self.registry = ModelRegistry(
             hbm_budget_mb=cfg.tpu_serve_hbm_budget_mb,
             warm_rows=cfg.tpu_serve_warm_rows,
@@ -41,6 +47,9 @@ class ServingService:
             self.registry,
             max_batch_wait_ms=cfg.tpu_serve_max_batch_wait_ms,
             max_batch_rows=cfg.tpu_serve_max_batch_rows)
+        if cfg.tpu_serve_metrics_port:
+            from .exporter import MetricsExporter
+            self.exporter = MetricsExporter(cfg.tpu_serve_metrics_port)
         self._watchers: Dict[str, CheckpointWatcher] = {}
         self._closed = False
 
@@ -75,13 +84,16 @@ class ServingService:
 
     # -- lifecycle ---------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "registry": self.registry.stats(),
             "coalescer": self.coalescer.stats(),
             "watchers": {n: {"polls": w.polls,
                              "versions": list(w.swapped)}
                          for n, w in self._watchers.items()},
         }
+        if self.exporter is not None:
+            out["metrics_endpoint"] = self.exporter.url
+        return out
 
     def close(self) -> None:
         if self._closed:
@@ -90,6 +102,8 @@ class ServingService:
         for w in self._watchers.values():
             w.stop()
         self.coalescer.close()
+        if self.exporter is not None:
+            self.exporter.close()
 
     def __enter__(self) -> "ServingService":
         return self

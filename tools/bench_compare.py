@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff two or more BENCH records and emit a
+verdict JSON suitable for a CI gate.
+
+    python tools/bench_compare.py BENCH_r01.json BENCH_r04.json
+    python tools/bench_compare.py BENCH_r0*.json --gate --out verdict.json
+
+Inputs are either driver wrapper records (``{"n", "cmd", "rc", "tail",
+"parsed"}`` — the BENCH_r0*.json series; ``parsed`` may be null for a
+timed-out round, which is reported as *incomplete* and excluded from
+comparison) or raw ``bench.py`` summary JSON. The first complete record
+is the base, the last is the candidate; records in between contribute to
+each metric's ``series`` (the trajectory view).
+
+Normalization (why a naive key-by-key diff lies):
+
+* a metric's base is the FIRST record that carries it (stages are added
+  over time — r01 predates the MSLR stage, so ``mslr_vs_baseline`` is
+  judged r03-vs-r04, with the effective base named in ``base_record``);
+  a metric the candidate itself lacks is reported with verdict
+  ``absent`` plus the reason when the record's ``stage_skips`` names the
+  owning stage (budget skips / env knobs must not read as regressions);
+* per-iteration-projected headline metrics (``value``,
+  ``value_255bin``, ``mslr_500iter_s`` are all projected to
+  ``BASELINE_ITERS`` by bench.py) compare cleanly even when
+  ``scale_iters`` shrank the measured run; raw per-stage walls
+  (``stage_wall_s``) and compile-miss counts are budget- and
+  scale-dependent, so they are carried as ``informational`` and never
+  gate;
+* quality metrics (AUC / NDCG) use a tight 0.5% threshold — a 5% AUC
+  drop is a catastrophe, not noise — while timing metrics default to
+  5% (``--threshold`` overrides the timing threshold only).
+
+Verdict JSON: ``{"schema", "records", "incomplete", "metrics": {name:
+{base, new, delta_pct, direction, verdict, series}}, "counts",
+"overall"}`` with per-metric verdicts ``regressed`` / ``improved`` /
+``neutral`` / ``absent`` / ``informational``. ``--gate`` exits 1 when
+``overall == "regressed"`` (any gated metric regressed).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+# direction: +1 higher-is-better, -1 lower-is-better. The gate judges
+# only metrics listed here; anything else numeric is informational.
+DIRECTION: Dict[str, int] = {
+    "value": -1,                 # higgs 500-iter projected seconds
+    "value_255bin": -1,
+    "mslr_500iter_s": -1,
+    "valid_overhead_pct": -1,
+    "warmup_s": -1,
+    "warmup_s_255bin": -1,
+    "vs_baseline": +1,           # x of the LightGBM CPU baseline
+    "mslr_vs_baseline": +1,
+    "predict_speedup": +1,
+    "warm_speedup": +1,
+    "coalesced_vs_direct": +1,
+    "mslr_rank_fused_speedup": +1,
+    "auc": +1,
+    "auc_ours_1m_100it": +1,
+    "ndcg10": +1,
+}
+# quality metrics: tiny moves are real; gate at 0.5%, not the timing 5%
+QUALITY = frozenset({"auc", "auc_ours_1m_100it", "ndcg10"})
+QUALITY_THRESHOLD_PCT = 0.5
+
+# metric -> bench stage that produces it, for attributing absences to a
+# recorded stage skip
+METRIC_STAGE = {
+    "value": "higgs63", "vs_baseline": "higgs63", "auc": "higgs63",
+    "warmup_s": "higgs63",
+    "value_255bin": "255bin", "warmup_s_255bin": "255bin",
+    "mslr_500iter_s": "mslr", "mslr_vs_baseline": "mslr",
+    "ndcg10": "mslr", "mslr_rank_fused_speedup": "mslr",
+    "predict_speedup": "predict",
+    "coalesced_vs_direct": "serve_traffic",
+    "valid_overhead_pct": "valid_overhead",
+    "warm_speedup": "warm_rerun",
+    "auc_ours_1m_100it": "ref_parity",
+}
+# keys never judged nor listed as informational scalars
+_SKIP_KEYS = frozenset({"metric", "unit", "stage_reached", "stages_done",
+                        "incomplete", "interrupted"})
+
+
+def load_record(path: str) -> Tuple[str, Optional[Dict[str, Any]]]:
+    """(label, summary-or-None). Wrapper records unwrap through
+    ``parsed``; a null parsed (timed-out round) returns None."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    label = os.path.basename(path)
+    if isinstance(doc, dict) and "parsed" in doc and "rc" in doc:
+        n = doc.get("n")
+        if isinstance(n, int):
+            label = f"r{n:02d}"
+        parsed = doc.get("parsed")
+        return label, parsed if isinstance(parsed, dict) else None
+    return label, doc if isinstance(doc, dict) else None
+
+
+def _numeric_keys(rec: Dict[str, Any]) -> Dict[str, float]:
+    out = {}
+    for k, v in rec.items():
+        if k in _SKIP_KEYS or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def _skip_reason(rec: Dict[str, Any], metric: str) -> Optional[str]:
+    stage = METRIC_STAGE.get(metric)
+    if stage is None:
+        return None
+    skips = rec.get("stage_skips") or {}
+    reason = skips.get(stage)
+    return f"stage {stage!r} skipped: {reason}" if reason else None
+
+
+def judge(metric: str, base: float, new: float,
+          threshold_pct: float) -> Tuple[str, float]:
+    """(verdict, delta_pct). delta_pct is signed relative change of the
+    raw value; the verdict folds in the metric's direction."""
+    if base == 0:
+        return ("informational", 0.0 if new == 0 else float("inf"))
+    delta_pct = (new - base) / abs(base) * 100.0
+    direction = DIRECTION.get(metric)
+    if direction is None:
+        return "informational", delta_pct
+    thr = QUALITY_THRESHOLD_PCT if metric in QUALITY else threshold_pct
+    gain = delta_pct * direction        # >0 = moved the good way
+    if gain > thr:
+        return "improved", delta_pct
+    if gain < -thr:
+        return "regressed", delta_pct
+    return "neutral", delta_pct
+
+
+def compare(records: List[Tuple[str, Optional[Dict[str, Any]]]],
+            threshold_pct: float = 5.0) -> Dict[str, Any]:
+    complete = [(lbl, rec) for lbl, rec in records if rec is not None]
+    incomplete = [lbl for lbl, rec in records if rec is None]
+    out: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "records": [lbl for lbl, _ in records],
+        "incomplete": incomplete,
+        "threshold_pct": threshold_pct,
+        "metrics": {},
+    }
+    if len(complete) < 2:
+        out["overall"] = "insufficient"
+        out["error"] = (f"need >= 2 complete records to compare, got "
+                        f"{len(complete)} (incomplete: {incomplete})")
+        return out
+    base_lbl, base = complete[0]
+    new_lbl, new = complete[-1]
+    out["base"], out["candidate"] = base_lbl, new_lbl
+    base_num, new_num = _numeric_keys(base), _numeric_keys(new)
+    judged = sorted(set(base_num) | set(new_num),
+                    key=lambda k: (DIRECTION.get(k) is None, k))
+    counts = {"regressed": 0, "improved": 0, "neutral": 0,
+              "absent": 0, "informational": 0}
+    for k in judged:
+        series = [(lbl, _numeric_keys(rec).get(k)) for lbl, rec in complete]
+        present = [(lbl, v) for lbl, v in series if v is not None]
+        # base falls back to the first record carrying the metric
+        # (stages appear over time); the candidate never falls back —
+        # a metric the newest record dropped must be explained, not
+        # silently judged against an older run.
+        eff_base_lbl, eff_base = present[0] if present else (None, None)
+        row: Dict[str, Any] = {
+            "base": eff_base, "new": new_num.get(k),
+            "direction": ("higher_better" if DIRECTION.get(k) == 1
+                          else "lower_better" if DIRECTION.get(k) == -1
+                          else None),
+        }
+        if len(complete) > 2:
+            row["series"] = series
+        if eff_base_lbl is not None and eff_base_lbl != base_lbl:
+            row["base_record"] = eff_base_lbl
+        if k not in new_num or len(present) < 2:
+            row["verdict"] = "absent"
+            if k not in new_num:
+                row["note"] = (_skip_reason(new, k)
+                               or f"metric absent from candidate {new_lbl}")
+            else:
+                row["note"] = (f"only {eff_base_lbl} carries this metric; "
+                               f"nothing to compare against")
+        else:
+            verdict, delta_pct = judge(k, eff_base, new_num[k],
+                                       threshold_pct)
+            row["verdict"] = verdict
+            if delta_pct not in (float("inf"), float("-inf")):
+                row["delta_pct"] = round(delta_pct, 2)
+            # trajectory direction over the whole series (flat = every
+            # carrying record within threshold of the effective base)
+            vals = [v for _, v in present]
+            if len(vals) > 2 and DIRECTION.get(k) is not None:
+                thr = (QUALITY_THRESHOLD_PCT if k in QUALITY
+                       else threshold_pct)
+                moved = [abs(v - vals[0]) / abs(vals[0]) * 100 > thr
+                         for v in vals[1:] if vals[0] != 0]
+                row["trajectory"] = ("flat" if not any(moved)
+                                     else verdict)
+            elif len(vals) == 2 and DIRECTION.get(k) is not None:
+                row["trajectory"] = ("flat" if verdict == "neutral"
+                                     else verdict)
+        counts[row["verdict"]] += 1
+        out["metrics"][k] = row
+    out["counts"] = counts
+    out["overall"] = ("regressed" if counts["regressed"]
+                      else "improved" if counts["improved"]
+                      else "neutral")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff BENCH records; emit a regression verdict")
+    ap.add_argument("records", nargs="+",
+                    help="2+ BENCH record paths, oldest first")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="timing regression threshold in %% (default 5; "
+                         "quality metrics always use "
+                         f"{QUALITY_THRESHOLD_PCT}%%)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when the overall verdict is 'regressed'")
+    ap.add_argument("--out", default="",
+                    help="also write the verdict JSON to this path")
+    args = ap.parse_args(argv)
+    if len(args.records) < 2:
+        ap.error("need at least two records")
+    verdict = compare([load_record(p) for p in args.records],
+                      threshold_pct=args.threshold)
+    text = json.dumps(verdict, indent=2, sort_keys=True, default=str)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    if verdict["overall"] == "insufficient":
+        return 2
+    if args.gate and verdict["overall"] == "regressed":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
