@@ -63,6 +63,17 @@ its host-fallback count ("fallbacks_255bin" / "mslr_fallbacks"), and
 whether the slot-hist store spilled to HBM through the DMA ring
 ("hist_spill_255bin" / "mslr_hist_spill").
 
+Per-term device time: "terms_by_stage" {stage: {term: ms}} — the
+training stages run with the in-run profiler armed (obs/profiler.py,
+tpu_profile=on at an unreachable cadence) and force ONE sampled round
+AFTER each timed loop, so the per_iter window never contains a fence;
+the sampled round's canonical terms_ms (obs/terms.py vocabulary:
+rank_grad, build, score_update, ...) lands here, the per-term twin of
+"hbm_by_stage". tools/bench_compare.py diffs it to attribute a stage
+timing regression to a term; tools/bottleneck_report.py merges it with
+a ledger + program_costs.json into the ranked report. BENCH_PROFILE=0
+disables the plane entirely.
+
 Crash-proofing (obs/bench_record.py): the cumulative record exists from
 second zero and every stage completion re-emits it AND atomically
 rewrites the BENCH_OUT sidecar file (default ./BENCH_partial.json, tmp +
@@ -77,9 +88,10 @@ Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
 BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1,
-BENCH_SKIP_RESUME=1, BENCH_SKIP_SERVE=1,
-BENCH_OUT=<path> (sidecar record), BENCH_TRACE=1 + BENCH_TRACE_DIR
-(obs span tracer + per-stage ledger records).
+BENCH_SKIP_RESUME=1, BENCH_SKIP_SERVE=1, BENCH_PROFILE=0 (disable the
+per-term profiler rounds), BENCH_OUT=<path> (sidecar record),
+BENCH_TRACE=1 + BENCH_TRACE_DIR (obs span tracer + per-stage ledger
+records).
 LGBT_COMPILE_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
 persistent-cache location (default: ./.jax_cache).
 """
@@ -293,6 +305,45 @@ def _sync(bst):
         np.asarray(g.train_score.score.reshape(-1)[:1])
 
 
+# in-run profiler on the stage boosters (obs/profiler.py): the stage
+# params carry tpu_profile=on with an unreachable cadence, so the
+# warmup/timed loops never sample (zero fences in the measured window);
+# after each timed loop ONE forced sampled round decomposes a
+# representative round into terms_ms, folded into the bench record as
+# terms_by_stage (the per-term twin of hbm_by_stage). BENCH_PROFILE=0
+# disables the whole plane.
+BENCH_PROFILE = os.environ.get("BENCH_PROFILE", "1") != "0"
+
+
+def _profile_params():
+    if not BENCH_PROFILE:
+        return {}
+    return {"tpu_profile": "on", "tpu_profile_every": 10 ** 9}
+
+
+def _profile_terms(bst):
+    """Force-sample one round NOW (after the timed loop) and return its
+    canonical terms_ms, or None when profiling is off/failed. The extra
+    update() grows one extra tree — call only after the stage's quality
+    numbers are computed."""
+    prof = getattr(getattr(bst, "_gbdt", None), "_profiler", None)
+    if prof is None:
+        return None
+    try:
+        prof.force_next()
+        bst.update()
+        _sync(bst)
+        terms = prof.last_terms
+        if terms:
+            log("# terms_ms: " + " ".join(
+                f"{k}={v:.1f}" for k, v in sorted(
+                    terms.items(), key=lambda kv: -(kv[1] or 0))))
+        return terms
+    except Exception as e:  # profiling must never void a bench record
+        log(f"# profile round FAILED: {type(e).__name__}: {e}")
+        return None
+
+
 def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
               X, y, full_iters=0):
     """Timed window (warmup + iters, projected to 500) plus, when
@@ -311,6 +362,7 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
         "verbosity": -1,
         "metric": "none",
     }
+    params.update(_profile_params())
     t0 = time.perf_counter()
     train_set = lgb.Dataset(X, label=y, params=params).construct()
     t_bin = time.perf_counter() - t0
@@ -364,6 +416,9 @@ def run_higgs(n, f, leaves, iters, warmup, max_bin, holdout_X, holdout_y,
         "hist_spill": bool(getattr(eng, "hist_spill", False))
         if eng is not None else False,
     }
+    terms = _profile_terms(bst)
+    if terms:
+        stats["terms_ms"] = terms
     return per_iter * BASELINE_ITERS, auc, done, stats
 
 
@@ -387,6 +442,7 @@ def run_mslr(n, f, iters, warmup, max_bin=255, ab_iters=0):
         "verbosity": -1,
         "metric": "none",
     }
+    params.update(_profile_params())
     t0 = time.perf_counter()
     ds = lgb.Dataset(X, label=y, group=group, params=params).construct()
     t_bin = time.perf_counter() - t0
@@ -451,6 +507,9 @@ def run_mslr(n, f, iters, warmup, max_bin=255, ab_iters=0):
         log(f"# mslr A/B: fused={per_iter * 1e3:.1f}ms "
             f"bucketed={per_b * 1e3:.1f}ms "
             f"speedup={info['rank_fused_speedup']}x")
+    terms = _profile_terms(bst)
+    if terms:
+        info["terms_ms"] = terms
     return per_iter * BASELINE_ITERS, nd, info
 
 
@@ -706,6 +765,9 @@ def main() -> None:
             "entries_after": entries_after,
         },
     })
+    if stats63.get("terms_ms"):
+        out.setdefault("terms_by_stage", {})["higgs63"] = \
+            stats63["terms_ms"]
     if full:
         out["auc_ours_full_63bin"] = out["auc"]
         if done63 < full:
@@ -738,6 +800,9 @@ def main() -> None:
         out["aligned_255bin"] = stats255["aligned"]
         out["fallbacks_255bin"] = stats255["fallbacks"]
         out["hist_spill_255bin"] = stats255["hist_spill"]
+        if stats255.get("terms_ms"):
+            out.setdefault("terms_by_stage", {})["255bin"] = \
+                stats255["terms_ms"]
         if full and auc255 is not None:
             out["auc_ours_full_255bin"] = round(auc255, 6)
             if done255 < full:
@@ -779,6 +844,9 @@ def main() -> None:
                   "rank_fused_speedup"):
             if k in minfo:
                 out[f"mslr_{k}"] = minfo[k]
+        if minfo.get("terms_ms"):
+            out.setdefault("terms_by_stage", {})["mslr"] = \
+                minfo["terms_ms"]
         _stage_done("mslr", out)
 
     # ---- stage 4: serving throughput (serve.ForestEngine vs the seed) --

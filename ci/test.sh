@@ -479,6 +479,87 @@ else
     rm -rf "$(dirname "$KILL_DIR")"
 fi
 
+echo "== profiler smoke (sampled terms -> ledger -> ranked report) =="
+PROF_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_profile"
+mkdir -p "$PROF_DIR"
+python - <<EOF
+import numpy as np
+rng = np.random.RandomState(13)
+X = rng.rand(900, 8).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(900) > 0.5).astype(np.float32)
+np.savetxt("$PROF_DIR/train.tsv",
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+# 6-round CLI run sampling rounds 2 and 4; the CLI writes the ledger,
+# program_costs.json and trace_summary.json under the trace dir.
+# Aligned interpret mode so the chained-k build calibration runs too
+# (it measures the live engine's kernels; the default path has none).
+python -m lightgbm_tpu task=train "data=$PROF_DIR/train.tsv" \
+    objective=binary num_leaves=15 num_iterations=6 verbosity=-1 \
+    "output_model=$PROF_DIR/model.txt" \
+    tpu_grow_mode=aligned tpu_aligned_interpret=true tpu_chunk=256 \
+    tpu_profile=on tpu_profile_every=2 \
+    tpu_trace=true "tpu_trace_dir=$PROF_DIR/trace" \
+    > "$PROF_DIR/train.log" 2>&1
+PROF_SMOKE_DIR="$PROF_DIR" python - <<'EOF'
+import glob
+import json
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+from lightgbm_tpu.obs.terms import TERMS
+
+tdir = os.path.join(os.environ["PROF_SMOKE_DIR"], "trace")
+paths = sorted(glob.glob(os.path.join(tdir, "ledger-*.jsonl")))
+assert paths, f"no ledger under {tdir}"
+recs = obs_ledger.read_ledger(paths[-1])
+for rec in recs:
+    obs_ledger.validate_record(rec)
+prof = [r for r in recs if r.get("kind") == "round" and r.get("profiled")]
+assert [r["round"] for r in prof] == [2, 4], prof
+for r in prof:
+    assert r["timing"] == "fenced" and set(r["terms_ms"]) <= set(TERMS)
+    assert abs(sum(r["terms_ms"].values()) - r["device_ms"]) < 0.05, r
+plain = [r for r in recs if r.get("kind") == "round"
+         and not r.get("profiled")]
+assert all("terms_ms" not in r for r in plain)
+notes = [r for r in recs if r.get("kind") == "note"
+         and r.get("note") == "profile_calibration"]
+assert len(notes) == 1, notes
+
+costs_path = os.path.join(tdir, "program_costs.json")
+assert os.path.isfile(costs_path), os.listdir(tdir)
+costs = json.load(open(costs_path))
+assert costs["schema"] == 1 and costs["programs"], costs.get("device")
+for tag, row in costs["programs"].items():
+    assert "calls" in row and "dispatch_ms_total" in row, (tag, row)
+print(f"profiler smoke: ok ({len(prof)} fenced rounds, "
+      f"{len(costs['programs'])} programs cost-analyzed)")
+EOF
+# the ranked report must exit 0 and rank at least one term
+python tools/bottleneck_report.py --trace-dir "$PROF_DIR/trace" \
+    --json "$PROF_DIR/report.json" > "$PROF_DIR/report.txt"
+PROF_SMOKE_DIR="$PROF_DIR" python - <<'EOF'
+import json
+import os
+
+d = os.environ["PROF_SMOKE_DIR"]
+rep = json.load(open(os.path.join(d, "report.json")))
+assert rep["ranked_terms"], rep
+assert rep["ranked_terms"][0]["mean_ms"] > 0, rep["ranked_terms"]
+assert rep.get("programs"), "program costs missing from report"
+txt = open(os.path.join(d, "report.txt")).read()
+assert "bottleneck report" in txt and "fenced terms" in txt
+top = rep["ranked_terms"][0]
+print(f"bottleneck report: ok (top term {top['term']!r} "
+      f"{top['mean_ms']}ms, {top['share'] * 100:.0f}% of fenced time)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "profiler artifacts kept under $PROF_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$PROF_DIR")"
+fi
+
 echo "== tests ($MODE tier) =="
 if [ "$MODE" = "full" ]; then
     python -m pytest tests/ -q

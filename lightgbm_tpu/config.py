@@ -550,6 +550,26 @@ class Config:
     # scrapers hit the /metrics exporter and checkpoint watchers may
     # hot-swap. SIGINT/SIGTERM end the hold early and exit cleanly
     tpu_serve_hold_s: float = 0.0
+    # in-run bottleneck profiler (obs/profiler.py): "off" (default,
+    # zero added fences — one is-None branch per round), "on", or
+    # "auto" (= on only when tpu_trace or tpu_metrics is already
+    # enabled). On sampled rounds the round's device time is fenced
+    # per dispatch site into a canonical terms_ms dict (ledger round
+    # record, train_term_ms metrics gauges, bench terms_by_stage), the
+    # fused build is decomposed once by in-run chained-k calibration,
+    # and XLA cost_analysis() for every registered program lands in
+    # program_costs.json. Runtime-only: excluded from model text and
+    # checkpoint signatures, like tpu_metrics
+    tpu_profile: str = "off"
+    # profile every Nth round (round 0 is never sampled — it pays the
+    # XLA compiles). Sampled rounds serialize the pipeline, so keep
+    # this sparse on real runs; their wall time is excluded from the
+    # train_round_ms histogram and marked timing="fenced" in the ledger
+    tpu_profile_every: int = 50
+    # "start:stop" round window bracketed in a programmatic
+    # jax.profiler trace; artifact directory paths land in
+    # trace_summary.json. Empty disables capture
+    tpu_profile_capture: str = ""
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

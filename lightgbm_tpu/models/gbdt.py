@@ -252,6 +252,20 @@ class GBDT:
             from ..resilience.faults import FaultPlan
             self._fault_plan = FaultPlan.from_config(
                 cfg, telemetry=self.telemetry)
+        # in-run bottleneck profiler (obs/profiler.py): None when off —
+        # the round loop pays one is-None check, and _prof_round is only
+        # non-None DURING a sampled round (the per-site fence seam in
+        # _dispatch_device). With the profiler live, compile_cache also
+        # starts capturing arg specs so program_costs.json can pair XLA
+        # cost_analysis() with measured dispatch wall
+        self._profiler = None
+        self._prof_round = None
+        if cfg.tpu_profile and str(cfg.tpu_profile).lower() != "off":
+            from ..obs.profiler import RoundProfiler
+            self._profiler = RoundProfiler.from_config(cfg)
+            if self._profiler is not None:
+                from .. import compile_cache
+                compile_cache.enable_arg_capture()
 
     @staticmethod
     def _reshape_init_score(ds: Dataset) -> Optional[np.ndarray]:
@@ -326,6 +340,12 @@ class GBDT:
         return 0.0
 
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
+        pr = self._prof_round
+        if pr is not None:
+            return pr.timed(
+                "objective.grad",
+                lambda: self.objective.get_gradients(
+                    self.get_training_score()))
         g, h = self.objective.get_gradients(self.get_training_score())
         return g, h
 
@@ -355,6 +375,11 @@ class GBDT:
         is_finished flag. With `tpu_trace` on, every round commits one
         ledger record (see _train_one_iter_traced); off, this is a
         single None check."""
+        prof = self._profiler
+        if prof is not None:
+            prof.maybe_capture(self.iter)
+            if prof.should_sample(self.iter):
+                return self._train_one_iter_profiled(prof, grad, hess)
         if self.telemetry is None:
             if self._metrics is None:
                 return self._train_one_iter_impl(grad, hess)
@@ -364,12 +389,25 @@ class GBDT:
     def _dispatch_device(self, what: str, fn, *args):
         """Every learner/engine device dispatch funnels through here so
         the resilience layer can inject deterministic faults and retry
-        transient device errors (resilience/retry.py). With no fault
-        plan and retries disabled this is a plain call."""
+        transient device errors (resilience/retry.py), and the in-run
+        profiler can fence each site on a sampled round (_prof_round is
+        non-None only then). With no fault plan, no retries, and no
+        active sample this is a plain call."""
+        pr = self._prof_round
         plan = self._fault_plan
         if plan is None and self.cfg.tpu_retry_max <= 0:
+            if pr is not None:
+                return pr.timed(what, fn, *args)
             return fn(*args)
         from ..resilience.retry import call_with_retry
+        if pr is not None:
+            # fence OUTSIDE the retry wrapper: a retried dispatch's
+            # whole recovery cost is device time the round really paid
+            return pr.timed(what, lambda: call_with_retry(
+                fn, args, what=what, plan=plan,
+                max_retries=self.cfg.tpu_retry_max,
+                backoff_s=self.cfg.tpu_retry_backoff_s,
+                telemetry=self.telemetry))
         return call_with_retry(
             fn, args, what=what, plan=plan,
             max_retries=self.cfg.tpu_retry_max,
@@ -433,6 +471,91 @@ class GBDT:
         if self._metrics is not None:
             self._note_round_metrics(rec["wall_ms"], rec["traces"],
                                      rec["fallbacks"])
+        return finished
+
+    def _train_one_iter_profiled(self, prof, grad, hess) -> bool:
+        """One profiler-sampled round: drain the pipelined backlog, then
+        run the untouched implementation with _prof_round set so every
+        dispatch site fences individually (obs/profiler.py RoundSample).
+        The resulting record carries timing="fenced" — device_ms is the
+        SUM of fenced site times, NOT the residual-drain convention of
+        _train_one_iter_traced — plus the canonical terms_ms; it is
+        excluded from the train_round_ms histogram so sampled rounds
+        cannot pollute p50/p99."""
+        import time as _time
+
+        from ..compile_cache import trace_count
+        from ..obs import trace as obs_trace
+        rnd = self.iter
+        # drain queued work from previous (pipelined) rounds BEFORE t0
+        # so the first fenced site doesn't absorb the backlog
+        obs_trace.force_fence(self._round_fence_target())
+        sample = prof.begin_round(rnd)
+        self._prof_round = sample
+        traces0 = trace_count()
+        t0 = _time.perf_counter()
+        try:
+            with obs_trace.step(rnd):
+                with obs_trace.span("train.round.profiled", round=rnd):
+                    finished = self._train_one_iter_impl(grad, hess)
+                    # residual drain: device work not covered by a
+                    # fenced site (host-applied trees, lazy syncs)
+                    sample.timed("round_tail", self._round_fence_target)
+        finally:
+            self._prof_round = None
+        t1 = _time.perf_counter()
+        traces = trace_count() - traces0
+        eng = getattr(self, "_aligned_eng_ref", None)
+        # finish AFTER reading the trace delta: the one-time build
+        # calibration compiles chained-k programs of its own
+        terms = prof.finish_round(sample, engine=eng, cfg=self.cfg)
+        fb = int(getattr(eng, "fallbacks", 0) or 0) if eng is not None \
+            else 0
+        path = getattr(self, "_iter_path", "unknown")
+        rec = {
+            "kind": "round", "round": rnd,
+            "wall_ms": round((t1 - t0) * 1e3, 3),
+            "device_ms": round(sample.device_total_ms(), 3),
+            "traces": traces,
+            "path": path,
+            "aligned": path.startswith("aligned"),
+            "fallbacks": fb - self._obs_fallbacks_seen,
+            "trees": len(self.models),
+            "bag_cnt": int(self.bag_data_cnt),
+            "finished": bool(finished),
+            "profiled": True,
+            "timing": "fenced",
+            "terms_ms": terms,
+        }
+        self._obs_fallbacks_seen = fb
+        notes = list(getattr(self, "_gate_notes", ()) or ())
+        if notes:
+            rec["gate_notes"] = notes
+            rec["hist_spill"] = any("spill" in n.lower() for n in notes)
+        if self.telemetry is not None:
+            if prof.calibration is not None \
+                    and not prof.calibration_committed:
+                prof.calibration_committed = True
+                self.telemetry.commit(
+                    {"kind": "note", "note": "profile_calibration",
+                     **prof.calibration})
+            self.telemetry.commit(rec)
+        m = self._metrics
+        if m is not None:
+            # counters advance, but round_ms.observe is deliberately
+            # SKIPPED: a fenced round's wall is not a residual-mode wall
+            m.rounds.inc()
+            if traces > 0:
+                m.retraces.inc(traces)
+            if rec["fallbacks"] > 0:
+                m.fallbacks.inc(rec["fallbacks"])
+            trees = len(self.models)
+            if trees > self._obs_trees_seen:
+                m.trees.inc(trees - self._obs_trees_seen)
+            self._obs_trees_seen = trees
+            for term, ms in terms.items():
+                if ms is not None:
+                    m.term_ms.labels(term=term).set(ms)
         return finished
 
     def _note_round_metrics(self, wall_ms: float, traces: int,
@@ -713,19 +836,28 @@ class GBDT:
             self.bag_data_indices, self.bag_data_cnt)
         # valid-set scores: committed-tree walks per class, gated by the
         # device-side chain flags (a later-discarded dispatch adds 0)
+        pr = self._prof_round
         for i, su in enumerate(self.valid_scores):
-            sc = su.score
-            for k, (spec, _nc, _ex, applied) in enumerate(outs):
-                sc = eng.apply_spec_to_scores(
-                    sc, k, self._valid_bins_dev[i], spec, applied,
-                    self.shrinkage_rate)
-            su.score = sc
+            def _walk(su=su, i=i):
+                sc = su.score
+                for k, (spec, _nc, _ex, applied) in enumerate(outs):
+                    sc = eng.apply_spec_to_scores(
+                        sc, k, self._valid_bins_dev[i], spec, applied,
+                        self.shrinkage_rate)
+                return sc
+            su.score = (_walk() if pr is None
+                        else pr.timed("score_update", _walk))
         if self.valid_scores:
-            stash = []
-            for su, ms in zip(self.valid_scores, self.valid_metrics):
-                stash.append([m.eval_dev(su.score, self.objective)
-                              for m in ms])
-            self._valid_eval_stash = stash
+            def _stash_evals():
+                st = []
+                for su, ms in zip(self.valid_scores,
+                                  self.valid_metrics):
+                    st.append([m.eval_dev(su.score, self.objective)
+                               for m in ms])
+                return st
+            self._valid_eval_stash = (
+                _stash_evals() if pr is None
+                else pr.timed("eval", _stash_evals))
         if len(self._pending_numsplits) >= 16 * K:
             res = self._resolve_aligned_pending_mc()
             if res is not None:
@@ -888,22 +1020,34 @@ class GBDT:
         # applied flag, so a dispatch the host later discards (inexact
         # predecessor / fallback) contributed exactly 0 and the exact
         # fallback's host application stays correct
+        pr = self._prof_round
         for i, su in enumerate(self.valid_scores):
             # the whole [K, Nv] buffer is donated and updated in place
             # at lane 0 — no gather/scatter copy pair per valid set
-            su.score = eng.apply_spec_to_scores(
-                su.score, 0, self._valid_bins_dev[i], spec, applied_dev,
-                self.shrinkage_rate)
+            if pr is not None:
+                su.score = pr.timed(
+                    "score_update", eng.apply_spec_to_scores,
+                    su.score, 0, self._valid_bins_dev[i], spec,
+                    applied_dev, self.shrinkage_rate)
+            else:
+                su.score = eng.apply_spec_to_scores(
+                    su.score, 0, self._valid_bins_dev[i], spec,
+                    applied_dev, self.shrinkage_rate)
         if self.valid_scores:
             # queue the device metric programs for THIS iteration before
             # the eager next build: the device executes in queue order,
             # so eval scalars resolve right after the walks instead of
             # behind the whole next build
-            stash = []
-            for su, ms in zip(self.valid_scores, self.valid_metrics):
-                stash.append([m.eval_dev(su.score, self.objective)
-                              for m in ms])
-            self._valid_eval_stash = stash
+            def _stash_evals():
+                st = []
+                for su, ms in zip(self.valid_scores,
+                                  self.valid_metrics):
+                    st.append([m.eval_dev(su.score, self.objective)
+                               for m in ms])
+                return st
+            self._valid_eval_stash = (
+                _stash_evals() if pr is None
+                else pr.timed("eval", _stash_evals))
             # train metrics likewise (valid_sets often include the train
             # set): queue device scalars over the materialized score
             # lane so per-iteration train eval doesn't have to discard
@@ -981,8 +1125,18 @@ class GBDT:
         if eng._pgrad is None:
             # non-pointwise objective (ranking): gradients need ROW order
             # — materialize scores on device, compute, re-ingest by rid
-            scores = eng.row_scores_dev()
-            gd, hd = self.objective.get_gradients(scores[None, :])
+            pr = self._prof_round
+            if pr is not None:
+                # the materialization exists only to feed the ranking
+                # gradient, so both dispatches bill to the grad site
+                # (→ rank_grad for ranking objectives)
+                gd, hd = pr.timed(
+                    "objective.grad",
+                    lambda: self.objective.get_gradients(
+                        eng.row_scores_dev()[None, :]))
+            else:
+                scores = eng.row_scores_dev()
+                gd, hd = self.objective.get_gradients(scores[None, :])
             grads = (gd[0], hd[0])
         return self._dispatch_device(
             "engine.train_iter",

@@ -60,19 +60,29 @@ class TermTimer:
 
     def __init__(self, meta: Optional[Dict[str, Any]] = None,
                  chain: int = DEFAULT_CHAIN, reps: int = DEFAULT_REPS,
-                 log: Optional[Callable[[str], None]] = None) -> None:
+                 log: Optional[Callable[[str], None]] = None,
+                 catalog: Optional[Dict[str, str]] = None) -> None:
         self.out: Dict[str, Any] = dict(meta or {})
         self.out["terms_ms"] = {}
         self.chain = chain
         self.reps = reps
         self._log = log or (lambda msg: None)
         self._ts: Dict[str, List[float]] = {}
+        # term-name registry (obs/terms.py TERMS): when provided, a
+        # measure() under a name outside the canonical vocabulary is a
+        # programming error, not data — tools pass it so their JSON
+        # lines can never drift from the ledger terms_ms vocabulary
+        self._catalog = catalog
 
     def measure(self, name: str, mk_fn: Callable[[int], Callable],
                 *args, rows: Optional[int] = None) -> Optional[float]:
         """Time one term; returns per-exec seconds or None on failure
         (failures are logged and recorded as null, never raised — a
         faulting term must not void the other terms' numbers)."""
+        if self._catalog is not None and name not in self._catalog:
+            raise ValueError(
+                f"term {name!r} not in the canonical term table "
+                f"(obs/terms.py TERMS: {sorted(self._catalog)})")
         try:
             with trace.span(f"devtime.{name}", chain=self.chain):
                 per, ts = chained_device_time(
@@ -93,6 +103,9 @@ class TermTimer:
     def derive(self, name: str, minuend: str, subtrahend: str) -> None:
         """terms_ms[name] = max(minuend - subtrahend, 0); the minuend is
         REMOVED (it was only measured to isolate the marginal term)."""
+        if self._catalog is not None and name not in self._catalog:
+            raise ValueError(
+                f"derived term {name!r} not in the canonical term table")
         terms = self.out["terms_ms"]
         if terms.get(minuend) is not None \
                 and terms.get(subtrahend) is not None:

@@ -14,7 +14,17 @@ Record kinds:
   Optional: ``gate_notes`` (e.g. "slot-hist spilled to HBM"),
   ``hist_spill`` bool, ``bag_cnt`` (bagging/GOSS sample size),
   ``finished`` (no-split stop flag), ``eval`` (folded in by the
-  ``log_telemetry`` callback after metrics run).
+  ``log_telemetry`` callback after metrics run), and — on
+  profiler-sampled rounds only — ``profiled`` bool, ``terms_ms``
+  (canonical per-term device ms, keys from ``obs.terms.TERMS``) and
+  ``timing``, which names the round's device-time convention:
+  ``"residual"`` (the default: ONE end-of-round fence, ``device_ms``
+  is the pipelined residual drain) vs ``"fenced"`` (profiler-sampled:
+  every dispatch site fenced individually, ``device_ms`` is the SUM of
+  fenced site times). The two are NOT comparable — a fenced round
+  serializes the pipeline — so readers (``tools/bench_compare.py``,
+  round-wall histograms) must split on ``timing``/``profiled`` before
+  aggregating; records without the field are ``"residual"``.
 - ``eval``  — per-round metric values, appended by the callback seam
   (the round record is already flushed by then; the eval record carries
   the same ``round`` index so readers can join them).
@@ -57,6 +67,17 @@ def validate_record(rec: Dict[str, Any]) -> None:
                 raise ValueError(f"bad {k}: {rec[k]!r}")
         if not isinstance(rec["aligned"], bool):
             raise ValueError(f"bad aligned flag: {rec['aligned']!r}")
+        if "terms_ms" in rec:
+            from .terms import validate_terms_ms
+            why = validate_terms_ms(rec["terms_ms"])
+            if why is not None:
+                raise ValueError(f"bad terms_ms: {why}")
+        timing = rec.get("timing")
+        if timing is not None and timing not in ("residual", "fenced"):
+            raise ValueError(f"bad timing mode: {timing!r} "
+                             f"(must be 'residual' or 'fenced')")
+        if "profiled" in rec and not isinstance(rec["profiled"], bool):
+            raise ValueError(f"bad profiled flag: {rec['profiled']!r}")
     if kind == "eval" and "round" not in rec:
         raise ValueError("eval record missing round index")
 

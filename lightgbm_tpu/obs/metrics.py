@@ -411,6 +411,11 @@ def train_instruments() -> Any:
         "train_retry_events_total",
         "resilience retry events by outcome",
         labelnames=("event",))
+    ns.term_ms = r.gauge(
+        "train_term_ms",
+        "per-term fenced device ms of the last profiler-sampled round "
+        "(obs/profiler.py; term names from obs/terms.py)",
+        labelnames=("term",))
     return ns
 
 

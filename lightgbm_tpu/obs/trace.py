@@ -188,6 +188,21 @@ def fence(x):
     return _block(x)
 
 
+def force_fence(x):
+    """Drain device work hanging off pytree `x` REGARDLESS of the
+    tracing flag — the in-run profiler's per-site fence on sampled
+    rounds (obs/profiler.py). Shares `_block` and `fence_count` with
+    `fence()` so the tier-1 zero-fence assertion (monkeypatching
+    `_block`) covers profiler fences too: a run with the profiler off
+    must never reach here."""
+    global fence_count, _block
+    if _block is None:
+        import jax
+        _block = jax.block_until_ready
+    fence_count += 1
+    return _block(x)
+
+
 def write(path: str, extra: Optional[Dict[str, Any]] = None) -> str:
     """Dump all completed spans (plus a summary header) to `path` as one
     JSON document — the CLI's end-of-training trace dump. `extra` keys

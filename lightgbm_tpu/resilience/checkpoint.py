@@ -54,7 +54,8 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
     "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
-    "tpu_serve_hold_s",
+    "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
+    "tpu_profile_capture",
 })
 
 

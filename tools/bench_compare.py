@@ -31,6 +31,18 @@ Normalization (why a naive key-by-key diff lies):
   drop is a catastrophe, not noise — while timing metrics default to
   5% (``--threshold`` overrides the timing threshold only).
 
+When both endpoint records carry ``terms_by_stage`` (per-term fenced
+device times from the in-run profiler, sampled once per bench stage —
+see bench.py / lightgbm_tpu/obs/profiler.py), the verdict additionally
+attributes movement to terms: ``terms_by_stage`` maps each stage to
+per-term deltas plus an ``attribution`` line like ``"mslr: rank_grad
++18%"`` naming the biggest absolute mover. Term times are measured
+under per-site fencing (``timing: "fenced"``), a different convention
+from the pipelined residual walls the headline metrics use, so they
+are ALWAYS informational — they explain a gated regression, they never
+gate themselves, and the two timing modes are never mixed in one
+comparison (see obs/ledger.py for the mode semantics).
+
 Verdict JSON: ``{"schema", "records", "incomplete", "metrics": {name:
 {base, new, delta_pct, direction, verdict, series}}, "counts",
 "overall"}`` with per-metric verdicts ``regressed`` / ``improved`` /
@@ -142,6 +154,40 @@ def judge(metric: str, base: float, new: float,
     return "neutral", delta_pct
 
 
+def compare_terms(base: Dict[str, Any],
+                  new: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Informational per-term diff of ``terms_by_stage``. Attributes a
+    stage's movement to a named term ("mslr: rank_grad +18%") but never
+    gates: fenced term times and residual headline walls are different
+    timing conventions (obs/ledger.py) and must not be mixed into one
+    verdict."""
+    b_stages = base.get("terms_by_stage")
+    n_stages = new.get("terms_by_stage")
+    if not isinstance(b_stages, dict) or not isinstance(n_stages, dict):
+        return None
+    out: Dict[str, Any] = {}
+    for stage in sorted(set(b_stages) & set(n_stages)):
+        b_terms, n_terms = b_stages[stage] or {}, n_stages[stage] or {}
+        rows = {}
+        for term in sorted(set(b_terms) | set(n_terms)):
+            bv, nv = b_terms.get(term), n_terms.get(term)
+            row: Dict[str, Any] = {"base_ms": bv, "new_ms": nv}
+            if isinstance(bv, (int, float)) and bv \
+                    and isinstance(nv, (int, float)):
+                row["delta_pct"] = round((nv - bv) / abs(bv) * 100.0, 1)
+            rows[term] = row
+        movers = [(t, r["delta_pct"]) for t, r in rows.items()
+                  if "delta_pct" in r]
+        entry: Dict[str, Any] = {"verdict": "informational",
+                                 "terms": rows}
+        if movers:
+            term, pct = max(movers, key=lambda kv: abs(kv[1]))
+            entry["attribution"] = \
+                f"{stage}: {term} {pct:+.0f}%"
+        out[stage] = entry
+    return out or None
+
+
 def compare(records: List[Tuple[str, Optional[Dict[str, Any]]]],
             threshold_pct: float = 5.0) -> Dict[str, Any]:
     complete = [(lbl, rec) for lbl, rec in records if rec is not None]
@@ -214,6 +260,10 @@ def compare(records: List[Tuple[str, Optional[Dict[str, Any]]]],
         counts[row["verdict"]] += 1
         out["metrics"][k] = row
     out["counts"] = counts
+    # per-term attribution rides along but never influences the verdict
+    terms = compare_terms(base, new)
+    if terms is not None:
+        out["terms_by_stage"] = terms
     out["overall"] = ("regressed" if counts["regressed"]
                       else "improved" if counts["improved"]
                       else "neutral")

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Ranked "where did the time go" report: merge the in-run profiler's
+artifacts into one ordered answer.
+
+Inputs (each optional — the report ranks whatever is available):
+
+  --trace-dir DIR   a tpu_trace/tpu_profile trace directory; resolves
+                    the newest ledger-*.jsonl, program_costs.json and
+                    trace_summary.json inside unless overridden
+  --ledger PATH     round ledger JSONL (profiled rounds carry terms_ms,
+                    timing="fenced"; the profile_calibration note
+                    decomposes the fused build term)
+  --costs PATH      program_costs.json (XLA cost_analysis per program,
+                    roofline classification, measured dispatch wall)
+  --trace-summary PATH
+                    trace_summary.json (compile-cache miss attribution)
+  --bench PATH      a BENCH record (terms_by_stage from bench.py)
+  --json PATH       also write the full report as JSON
+  --top N           rows per section in the text report (default 8)
+
+The report:
+
+  1. ranked fenced terms — mean ms over profiled ledger rounds (the
+     canonical obs/terms.py vocabulary), with the fused `build` term
+     decomposed by the calibration note's shares when present
+  2. per-stage bench terms — terms_by_stage ranked per stage
+  3. top programs — by measured dispatch wall, with flops / bytes /
+     compute-vs-bandwidth bound and the roofline estimate
+  4. compile-cache miss offenders — which program recompiled most
+
+Exit code 0 whenever a report was produced (even a partial one); 2 when
+NO input yielded any data. This is the tool to run FIRST before
+touching a slow stage — e.g. an MSLR regression should name rank_grad
+here before anyone re-derives it with offline scripts.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _load_json(path, what):
+    if not path:
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except Exception as e:  # noqa: BLE001 — partial reports are fine
+        log(f"# {what} unreadable ({type(e).__name__}): {path}")
+        return None
+
+
+def _resolve_trace_dir(args):
+    d = args.trace_dir
+    if not d:
+        return
+    if not args.ledger:
+        ledgers = sorted(glob.glob(os.path.join(d, "ledger-*.jsonl")),
+                         key=os.path.getmtime)
+        if ledgers:
+            args.ledger = ledgers[-1]
+    if not args.costs:
+        p = os.path.join(d, "program_costs.json")
+        if os.path.isfile(p):
+            args.costs = p
+    if not args.trace_summary:
+        p = os.path.join(d, "trace_summary.json")
+        if os.path.isfile(p):
+            args.trace_summary = p
+
+
+def ranked_terms(ledger_rows):
+    """Mean terms_ms over FENCED (profiled) rounds only + the
+    calibration note — residual-mode rounds never mix in (the two
+    timing conventions are not comparable; see obs/ledger.py)."""
+    acc = {}
+    rounds = []
+    calibration = None
+    for rec in ledger_rows:
+        if rec.get("kind") == "note" \
+                and rec.get("note") == "profile_calibration":
+            calibration = rec
+        if rec.get("kind") != "round":
+            continue
+        if rec.get("timing") != "fenced" or not rec.get("terms_ms"):
+            continue
+        rounds.append(rec["round"])
+        for term, ms in rec["terms_ms"].items():
+            if ms is not None:
+                acc.setdefault(term, []).append(float(ms))
+    means = {t: sum(v) / len(v) for t, v in acc.items()}
+    total = sum(means.values()) or 1.0
+    ranked = [{"term": t, "mean_ms": round(ms, 3),
+               "share": round(ms / total, 4),
+               "rounds": len(acc[t])}
+              for t, ms in sorted(means.items(), key=lambda kv: -kv[1])]
+    return ranked, rounds, calibration
+
+
+def decompose_build(ranked, calibration):
+    """Split the fenced `build` entry by the calibration shares (per-
+    pass chained-k rates over the live engine — obs/profiler.py)."""
+    if calibration is None:
+        return None
+    shares = calibration.get("shares") or {}
+    build = next((r for r in ranked if r["term"] == "build"), None)
+    if build is None or not shares:
+        return None
+    return {
+        "build_ms": build["mean_ms"],
+        "by_term": {t: round(build["mean_ms"] * s, 3)
+                    for t, s in sorted(shares.items(),
+                                       key=lambda kv: -kv[1])},
+        "shares": shares,
+        "calibration_shapes": calibration.get("shapes"),
+    }
+
+
+def program_rows(costs, top):
+    progs = (costs or {}).get("programs") or {}
+    rows = []
+    for tag, row in progs.items():
+        rows.append({
+            "program": tag,
+            "dispatch_ms_total": row.get("dispatch_ms_total"),
+            "dispatch_ms_per_call": row.get("dispatch_ms_per_call"),
+            "calls": row.get("calls"),
+            "flops": row.get("flops"),
+            "bytes_accessed": row.get("bytes_accessed"),
+            "bound": row.get("bound"),
+            "est_ms": row.get("est_ms"),
+            "arithmetic_intensity": row.get("arithmetic_intensity"),
+            "error": row.get("error"),
+        })
+    rows.sort(key=lambda r: -(r["dispatch_ms_total"] or 0.0))
+    return rows[:top]
+
+
+def miss_rows(summary, top):
+    misses = ((summary or {}).get("compile_cache") or {}) \
+        .get("miss_by_program") or {}
+    return [{"program": p, "misses": n}
+            for p, n in sorted(misses.items(),
+                               key=lambda kv: -kv[1])[:top]]
+
+
+def stage_rows(bench):
+    # driver wrapper records ({"n", "cmd", "rc", "parsed"} — the
+    # BENCH_r0*.json series) carry the summary under "parsed"
+    if isinstance(bench, dict) and "parsed" in bench and "rc" in bench:
+        bench = bench.get("parsed")
+    stages = (bench or {}).get("terms_by_stage") or {}
+    out = {}
+    for stage, terms in stages.items():
+        total = sum(v for v in terms.values() if v) or 1.0
+        out[stage] = [{"term": t, "ms": round(v, 3),
+                       "share": round(v / total, 4)}
+                      for t, v in sorted(terms.items(),
+                                         key=lambda kv: -(kv[1] or 0))
+                      if v is not None]
+    return out
+
+
+def build_report(args):
+    from lightgbm_tpu.obs.ledger import read_ledger
+    _resolve_trace_dir(args)
+    report = {"schema": 1, "inputs": {
+        "ledger": args.ledger, "costs": args.costs,
+        "trace_summary": args.trace_summary, "bench": args.bench}}
+    rows = []
+    if args.ledger and os.path.isfile(args.ledger):
+        try:
+            rows = read_ledger(args.ledger)
+        except Exception as e:  # noqa: BLE001
+            log(f"# ledger unreadable ({type(e).__name__}): "
+                f"{args.ledger}")
+    ranked, rounds, calibration = ranked_terms(rows)
+    report["ranked_terms"] = ranked
+    report["profiled_rounds"] = rounds
+    decomp = decompose_build(ranked, calibration)
+    if decomp:
+        report["build_decomposition"] = decomp
+    costs = _load_json(args.costs, "program_costs")
+    if costs:
+        report["device"] = costs.get("device")
+        report["programs"] = program_rows(costs, args.top)
+    summary = _load_json(args.trace_summary, "trace_summary")
+    if summary:
+        report["compile_misses"] = miss_rows(summary, args.top)
+        prof = summary.get("profiler") or {}
+        if prof.get("captures"):
+            report["captures"] = prof["captures"]
+    bench = _load_json(args.bench, "bench record")
+    if bench:
+        report["terms_by_stage"] = stage_rows(bench)
+    return report
+
+
+def print_report(report, top):
+    p = print
+    p("=" * 64)
+    p("bottleneck report — ranked device-time attribution")
+    p("=" * 64)
+    ranked = report.get("ranked_terms") or []
+    if ranked:
+        p(f"\nfenced terms (mean over profiled rounds "
+          f"{report.get('profiled_rounds')}):")
+        for i, r in enumerate(ranked[:top], 1):
+            p(f"  {i}. {r['term']:<14} {r['mean_ms']:>10.2f} ms  "
+              f"{r['share'] * 100:5.1f}%")
+    decomp = report.get("build_decomposition")
+    if decomp:
+        p(f"\nbuild decomposition (chained-k calibration shares, "
+          f"build={decomp['build_ms']:.2f} ms):")
+        for t, ms in decomp["by_term"].items():
+            p(f"     build/{t:<12} {ms:>10.2f} ms  "
+              f"{decomp['shares'].get(t, 0) * 100:5.1f}%")
+    stages = report.get("terms_by_stage") or {}
+    for stage, rows in stages.items():
+        p(f"\nbench stage {stage!r} terms:")
+        for r in rows[:top]:
+            p(f"     {r['term']:<14} {r['ms']:>10.2f} ms  "
+              f"{r['share'] * 100:5.1f}%")
+    progs = report.get("programs") or []
+    if progs:
+        dev = report.get("device") or {}
+        p(f"\ntop programs by measured dispatch wall "
+          f"(device={dev.get('kind', '?')}, "
+          f"ridge={dev.get('ridge_flops_per_byte', '?')} flop/B):")
+        for r in progs[:top]:
+            if r.get("error"):
+                p(f"     {r['program']:<28} cost_analysis failed: "
+                  f"{r['error']}")
+                continue
+            p(f"     {r['program']:<28} {r['dispatch_ms_total']:>9.1f} ms"
+              f" ({r['calls']}x)  bound={r.get('bound') or '?':<9}"
+              f" est={r.get('est_ms')} ms/call")
+    misses = report.get("compile_misses") or []
+    if misses:
+        p("\ncompile-cache miss offenders:")
+        for r in misses[:top]:
+            p(f"     {r['program']:<36} {r['misses']} misses")
+    caps = report.get("captures") or []
+    if caps:
+        p("\njax.profiler capture artifacts:")
+        for c in caps:
+            p(f"     {c}")
+    p("")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="ranked per-term device-time report")
+    ap.add_argument("--trace-dir", default="")
+    ap.add_argument("--ledger", default="")
+    ap.add_argument("--costs", default="")
+    ap.add_argument("--trace-summary", default="")
+    ap.add_argument("--bench", default="")
+    ap.add_argument("--json", default="", dest="json_out")
+    ap.add_argument("--top", type=int, default=8)
+    args = ap.parse_args(argv)
+    report = build_report(args)
+    has_data = any(report.get(k) for k in
+                   ("ranked_terms", "programs", "compile_misses",
+                    "terms_by_stage"))
+    print_report(report, args.top)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+        log(f"# json report: {args.json_out}")
+    if not has_data:
+        log("# no usable input (need --trace-dir/--ledger/--costs/"
+            "--bench)")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,12 +32,21 @@ DT255_CHUNK (1024), DT255_SPLITK (16), DT255_REPS (3), DT255_CHAIN (8),
 DT255_RANK_DOCS (2_270_000; 0 skips the rank_grad term),
 DT255_INTERPRET=1 (CPU interpret-mode kernels — the -m slow smoke test
 in tests/test_subbin_spill.py runs a tiny shape this way).
+
+Term names come from the canonical vocabulary in
+``lightgbm_tpu.obs.terms.TERMS`` (the TermTimer runs with the catalog,
+so a drifted name is a crash, not quiet JSON): a "rank_grad" in this
+tool's output and one in a profiler ledger are the same quantity.
 """
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# what this tool measures, in canonical obs/terms.py vocabulary
+# (asserted against TERMS by tests/test_profiler.py)
+TERMS_MEASURED = ("route", "flush", "hist", "split_eval", "rank_grad")
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +71,7 @@ def log(msg):
 def main():
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.obs.devicetime import TermTimer
+    from lightgbm_tpu.obs.terms import TERMS
     from lightgbm_tpu.ops.aligned import hist_layout, move_pass, \
         pack_records, pack_route2, slot_hist_pass
     from lightgbm_tpu.ops.split import SplitHyper, make_split_finder
@@ -89,7 +99,7 @@ def main():
 
     tt = TermTimer({"n": N, "features": F, "max_bin": MB, "chunk": C,
                     "subbin": subbin, "spill": spill},
-                   chain=CHAIN, reps=REPS, log=log)
+                   chain=CHAIN, reps=REPS, log=log, catalog=TERMS)
 
     # ---- route / flush: every block splits at mid-bin -----------------
     r1 = np.full(NC, (MB // 2) | (1 << 13), np.int32)

@@ -3,13 +3,24 @@
 jitted program (fori_loop), time via device_get deltas between k=1 and
 k=K. Removes host dispatch / tunnel overhead from the numbers.
 
-Thin CLI over ``lightgbm_tpu.obs.devicetime.chained_device_time`` (the
-shared protocol implementation); this file only builds the move/hist
-closures and prints the human-readable per-C lines.
+Thin CLI over ``lightgbm_tpu.obs.devicetime.TermTimer`` (the shared
+chained-k protocol); this file only builds the move/hist closures for a
+sweep over chunk sizes. Term names come from the canonical vocabulary
+in ``lightgbm_tpu.obs.terms.TERMS`` — the same names the in-run
+profiler writes to ledger ``terms_ms``:
+
+  route   move_pass, every block splitting, NO hist slots
+  flush   hist-accumulating move_pass minus route (marginal fused
+          accumulate + slot flush; derived, minuend hist_move)
+  copy    move_pass with every block copied whole (no split, no hist)
+  hist    slot_hist_pass over the full record store
+
+Prints the human per-C lines on stderr and ONE JSON line per C on
+stdout: {"n": ..., "max_bin": ..., "chunk": C, "terms_ms": {...}}.
 
 python tools/device_time_r4.py [n] [max_bin] [C ...]
 """
-import functools
+import json
 import os
 import sys
 
@@ -20,6 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+# what this tool measures, in canonical obs/terms.py vocabulary
+# (asserted against TERMS by tests/test_profiler.py)
+TERMS_MEASURED = ("route", "flush", "copy", "hist")
+
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
 MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
 CS = [int(c) for c in sys.argv[3:]] or [512, 1024, 2048]
@@ -28,8 +43,13 @@ S = 64
 K = 8
 
 
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main():
-    from lightgbm_tpu.obs.devicetime import chained_device_time
+    from lightgbm_tpu.obs.devicetime import TermTimer
+    from lightgbm_tpu.obs.terms import TERMS
     from lightgbm_tpu.ops.aligned import move_pass, pack_records, \
         pack_route2, slot_hist_pass
 
@@ -54,7 +74,7 @@ def main():
         wsel = np.zeros(NC, np.int32)
         nohist = np.full(NC, S + 1, np.int32)
 
-        # ---- split-everything (block = whole data, no hist)
+        # split-everything routing: block = whole data at mid-bin
         r1 = np.full(NC, (MB // 2) | (1 << 13), np.int32)
         meta = meta_cnt.copy()
         meta[0] |= 1 << 20
@@ -62,45 +82,40 @@ def main():
         basel = np.zeros(NC, np.int32)
         baser = np.full(NC, nc_data // 2, np.int32)
 
-        def mk_move(k, hsl, r1v, metav, blv, brv):
+        tt = TermTimer({"n": N, "max_bin": MB, "chunk": C},
+                       chain=K,
+                       log=lambda m, C=C: log(f"C={C} {m}"),
+                       catalog=TERMS)
+
+        def mk_move(hsl, r1v, metav, blv, brv):
             cb0 = jnp.zeros((S + 2) * 8, jnp.int32)
             a = tuple(jnp.asarray(x) for x in
                       (r1v, r2, blv, brv, metav, wsel, hsl))
 
-            @jax.jit
-            def f(r):
-                def body(i, r):
-                    r2_, _ = move_pass(r, *a, cb0, C, W, wcnt, S + 1, F,
-                                       B, group)
-                    return r2_
-                return lax.fori_loop(0, k, body, r)
-            return f
+            def mk(k):
+                @jax.jit
+                def f(r):
+                    def body(i, r):
+                        r2_, _ = move_pass(r, *a, cb0, C, W, wcnt,
+                                           S + 1, F, B, group)
+                        return r2_
+                    return lax.fori_loop(0, k, body, r)
+                return f
+            return mk
 
-        try:
-            per, ts = chained_device_time(functools.partial(
-                mk_move, hsl=nohist, r1v=r1, metav=meta, blv=basel,
-                brv=baser), rec, chain=K)
-            print(f"C={C}: move_split_nohist dev={per*1e3:.1f}ms "
-                  f"({per/N*1e9:.2f}ns/row) [t1={ts[0]*1e3:.0f} "
-                  f"tK={ts[1]*1e3:.0f}]", flush=True)
-            per, ts = chained_device_time(functools.partial(
-                mk_move, hsl=np.zeros(NC, np.int32), r1v=r1, metav=meta,
-                blv=basel, brv=baser), rec, chain=K)
-            print(f"C={C}: move_split_hist  dev={per*1e3:.1f}ms "
-                  f"({per/N*1e9:.2f}ns/row)", flush=True)
-            r1c = np.full(NC, (1 << 16), np.int32)
-            metac = (meta_cnt | (1 << 20) | (1 << 21)).astype(np.int32)
-            per, ts = chained_device_time(functools.partial(
-                mk_move, hsl=nohist, r1v=r1c, metav=metac, blv=iota,
-                brv=iota), rec, chain=K)
-            print(f"C={C}: move_all_copy    dev={per*1e3:.1f}ms "
-                  f"({per/N*1e9:.2f}ns/row)", flush=True)
-        except Exception as e:
-            print(f"C={C}: move FAILED {type(e).__name__} {str(e)[:200]}",
-                  flush=True)
+        tt.measure("route", mk_move(nohist, r1, meta, basel, baser),
+                   rec, rows=N)
+        tt.measure("hist_move",
+                   mk_move(np.zeros(NC, np.int32), r1, meta, basel,
+                           baser), rec, rows=N)
+        tt.derive("flush", "hist_move", "route")
+        r1c = np.full(NC, (1 << 16), np.int32)
+        metac = (meta_cnt | (1 << 20) | (1 << 21)).astype(np.int32)
+        tt.measure("copy", mk_move(nohist, r1c, metac, iota, iota),
+                   rec, rows=N)
 
-        # ---- hist full pass (chained via a tiny record perturbation so
-        # the loop body cannot be hoisted)
+        # hist full pass (chained via a tiny record perturbation so the
+        # loop body cannot be hoisted)
         slots = np.zeros(NC, np.int32)
         slots[nc_data:] = S + 1
         sl_j = jnp.asarray(slots)
@@ -118,15 +133,10 @@ def main():
                 return lax.fori_loop(0, k, body, (r, jnp.float32(0.0)))
             return f
 
-        try:
-            per, ts = chained_device_time(mk_hist, rec, chain=K)
-            print(f"C={C}: hist_full        dev={per*1e3:.1f}ms "
-                  f"({per/N*1e9:.2f}ns/row)", flush=True)
-        except Exception as e:
-            print(f"C={C}: hist FAILED {type(e).__name__} {str(e)[:200]}",
-                  flush=True)
+        tt.measure("hist", mk_hist, rec, rows=N)
+        print(json.dumps(tt.out), flush=True)
         del rec
-    print("done", flush=True)
+    log("done")
 
 
 if __name__ == "__main__":

@@ -23,6 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# what this tool measures, in canonical obs/terms.py vocabulary
+# (asserted against TERMS by tests/test_profiler.py)
+TERMS_MEASURED = ("rank_grad",)
+
+
 def _argint(i, d):
     try:
         return int(sys.argv[i])
@@ -95,6 +100,7 @@ def main():
     # ---- rank_grad device-time attribution (chained-k protocol) -------
     from jax import lax
     from lightgbm_tpu.obs.devicetime import TermTimer
+    from lightgbm_tpu.obs.terms import TERMS
     obj = gb.objective
     tt = TermTimer(
         {"n": N, "features": F, "max_bin": MB, "mode": MODE,
@@ -104,7 +110,8 @@ def main():
              getattr(obj, "rank_fused_fallback_queries", 0))},
         chain=int(os.environ.get("PM_CHAIN", 4)),
         reps=int(os.environ.get("PM_REPS", 2)),
-        log=lambda m: print(m, file=sys.stderr, flush=True))
+        log=lambda m: print(m, file=sys.stderr, flush=True),
+        catalog=TERMS)
     if eng is not None:
         sc0 = eng.row_scores_dev()
     else:
