@@ -560,6 +560,89 @@ else
     rm -rf "$(dirname "$PROF_DIR")"
 fi
 
+echo "== graftlint (invariant gate) =="
+# the real tree must be clean: exit 0, no new findings
+python -m tools.lint
+# the gate must actually gate: an injected violation of each rule in a
+# scratch tree must exit nonzero and name its rule in the JSON report
+LINT_DIR="$(mktemp -d)/glt"
+mkdir -p "$LINT_DIR/lightgbm_tpu/obs"
+cat > "$LINT_DIR/lightgbm_tpu/bad.py" <<'EOF'
+import os
+import time
+
+import jax
+
+from .utils import log
+
+
+def g(a):
+    return a + 1
+
+
+def run(x):
+    fn = jax.jit(g, donate_argnums=(0,))
+    y = fn(x)
+    jax.block_until_ready(y)
+    log.event("not_a_kind", n=1)
+    return x + y
+
+
+def step(a):
+    return a + time.time() + float(os.environ.get("K", "0"))
+
+
+prog = jax.jit(step)
+
+
+class Box:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._items = []        # guarded-by: _lock
+
+    def put(self, v):
+        self._items.append(v)
+EOF
+cat > "$LINT_DIR/lightgbm_tpu/obs/events.py" <<'EOF'
+EVENTS = {"good_kind": "only catalogued kind"}
+EOF
+cat > "$LINT_DIR/lightgbm_tpu/config.py" <<'EOF'
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    tpu_alpha: int = 1
+    tpu_orphan: int = 2      # in neither signature nor runtime set
+EOF
+cat > "$LINT_DIR/lightgbm_tpu/compile_cache.py" <<'EOF'
+def config_signature(cfg):
+    names = ["tpu_alpha"]
+    return tuple((n, getattr(cfg, n)) for n in names)
+EOF
+mkdir -p "$LINT_DIR/lightgbm_tpu/resilience"
+cat > "$LINT_DIR/lightgbm_tpu/resilience/checkpoint.py" <<'EOF'
+RUNTIME_ONLY_PARAMS = frozenset()
+EOF
+if python -m tools.lint --root "$LINT_DIR" --paths lightgbm_tpu \
+        --json > "$LINT_DIR/report.json"; then
+    echo "graftlint FAILED to flag the injected violations" >&2
+    exit 1
+fi
+LINT_REPORT="$LINT_DIR/report.json" python - <<'EOF'
+import json
+import os
+
+rep = json.load(open(os.environ["LINT_REPORT"]))
+hit = {f["rule"] for f in rep["new"]}
+want = {"LGT001", "LGT002", "LGT003", "LGT004", "LGT005", "LGT006"}
+assert want <= hit, f"injected violations missed: {sorted(want - hit)}"
+print(f"graftlint gate: ok (clean tree green, injected tree flagged "
+      f"{sorted(hit)})")
+EOF
+rm -rf "$(dirname "$LINT_DIR")"
+
 echo "== tests ($MODE tier) =="
 if [ "$MODE" = "full" ]; then
     python -m pytest tests/ -q

@@ -550,6 +550,16 @@ class Config:
     # scrapers hit the /metrics exporter and checkpoint watchers may
     # hot-swap. SIGINT/SIGTERM end the hold early and exit cleanly
     tpu_serve_hold_s: float = 0.0
+    # runtime lock-discipline assertions (utils/locks.py): install a
+    # checking __setattr__ on the serving/metrics classes whose shared
+    # state is declared `# guarded-by:` — a guarded attribute rebound
+    # outside its lock is recorded as a violation (read via
+    # locks.violations(); the slow serving stress test asserts zero).
+    # The dynamic twin of graftlint's static LGT004 rule. Off by
+    # default and free when off (no wrapper is installed). Also
+    # settable via the LGBT_DEBUG_LOCKS environment variable.
+    # Runtime-only: excluded from model text and checkpoint signatures
+    tpu_debug_locks: bool = False
     # in-run bottleneck profiler (obs/profiler.py): "off" (default,
     # zero added fences — one is-None branch per round), "on", or
     # "auto" (= on only when tpu_trace or tpu_metrics is already

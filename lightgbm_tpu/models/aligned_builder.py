@@ -410,7 +410,7 @@ class AlignedEngine:
         from ..ops.aligned import hist_layout
         _bh = lr.hist_bins if lr.bundled else lr.max_bin_global
         import os as _os
-        kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0) or 256
+        kcap = int(_os.environ.get("LGBT_KCAP", "0") or 0) or 256  # graftlint: disable=LGT006 sound: LGBT_KCAP is mirrored into _trace_sig, so a changed value changes the cache key
         K = min(Sm1, kcap)
         subbin, spill, slot_bytes, spill_budget = hist_layout(
             cfg, self.ncols, _bh, K)

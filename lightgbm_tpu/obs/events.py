@@ -1,0 +1,75 @@
+"""Canonical structured-event vocabulary.
+
+One table names every ``log.event(kind, ...)`` record the framework can
+emit — the same role ``obs/terms.py`` plays for device-time terms. The
+emit side validates against THIS dict when ``__debug__`` (utils/log.py),
+graftlint's LGT005 checker validates every literal kind at lint time,
+and ``parse_event`` consumers can rely on the catalog being closed: a
+kind that is not here is a bug, not a new feature.
+
+Why a catalog and not grep: event kinds are the join key between the
+ledger, the bench record, CI assertions (e.g. the serving smoke counts
+``serve_swap`` notes) and offline tooling. A renamed or misspelled kind
+silently breaks those joins — drift used to be caught only by whichever
+test happened to parse the affected line, or not at all.
+
+Adding an event: add the kind + one-line description here, then emit it.
+``tools/lint`` fails the build on an uncatalogued literal kind; dynamic
+kinds (f-strings) are rejected outright unless suppressed with a reason.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# kind -> one-line description (keep alphabetized within each block)
+EVENTS: Dict[str, str] = {
+    # training path + compile plane
+    "aligned_fallback": "aligned engine exact-replay fallback count for "
+                        "a finished training run",
+    "compile_cache_miss": "persistent-compile-cache miss, with the "
+                          "traced program signature (warm-up forensics)",
+    "telemetry": "per-round ledger record mirrored onto the event "
+                 "channel by the telemetry callback",
+    "train_path": "which training path a run took (fused / aligned / "
+                  "level / host) plus the gate notes that routed it",
+    # ranking
+    "rank_buckets": "bucketed lambdarank pad ladder: per-bucket query/"
+                    "doc counts and pair-padding waste",
+    "rank_fused": "segment-fused lambdarank kernel status: tile stats "
+                  "on build, or a fallback with its reason",
+    # prediction / serving
+    "predict_route": "Booster.predict routing decision (device engine "
+                     "vs native host walk) and why",
+    "serve_compile": "ForestEngine compiled a new shape-bucket program",
+    "serve_evict": "registry evicted an LRU entry over the HBM budget",
+    "serve_load": "registry loaded (or replaced) a named model",
+    "serve_over_budget": "a single protected entry alone exceeds the "
+                         "HBM budget (load proceeds with a warning)",
+    "serve_swap": "registry hot-swapped a named model to a new version",
+    "serve_watch_bad_model": "checkpoint watcher skipped a torn/invalid "
+                             "model version (retried next tick)",
+    "serve_watch_error": "checkpoint watcher poll raised; the thread "
+                         "survives and retries",
+    # resilience
+    "checkpoint": "full-training-state checkpoint written (iter, path, "
+                  "reason, write cost)",
+    "fault": "deterministic fault injection fired (tests/CI)",
+    "preempt": "SIGTERM/SIGINT observed; training will checkpoint and "
+               "exit 75 after the in-flight round",
+    "resume": "training resumed from a checkpoint (iter, source)",
+    "retry": "transient device-dispatch error; retrying with backoff",
+    "retry_exhausted": "dispatch retries exhausted; error propagates",
+    "retry_recovered": "dispatch succeeded after transient-error "
+                       "retries",
+}
+
+
+def validate_kind(kind: Any) -> Optional[str]:
+    """None when `kind` is a catalogued event kind; else a reason
+    string (utils/log.event asserts on this under ``__debug__``)."""
+    if not isinstance(kind, str):
+        return f"event kind must be a str, got {type(kind).__name__}"
+    if kind not in EVENTS:
+        return (f"unknown event kind {kind!r} — add it to "
+                f"obs/events.py EVENTS")
+    return None

@@ -40,9 +40,9 @@ __all__ = ["track", "untrack", "owners_bytes", "claimed_total",
 _lock = threading.Lock()
 # name -> (weakref-or-None, fn, aggregate). fn takes the live object (or
 # no argument when obj was registered as None) and returns bytes.
-_owners: Dict[str, Any] = {}
-_peak_claimed = 0
-_peak_in_use = 0
+_owners: Dict[str, Any] = {}                        # guarded-by: _lock
+_peak_claimed = 0                                   # guarded-by: _lock
+_peak_in_use = 0                                    # guarded-by: _lock
 
 
 def track(name: str, obj: Optional[Any], fn: Callable[..., int],

@@ -29,6 +29,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import locks
+
 __all__ = ["SCHEMA_VERSION", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "registry", "enable", "disable", "enabled",
            "reset", "snapshot", "to_prometheus", "train_instruments",
@@ -78,6 +80,7 @@ def _fmt_labels(labelnames: Sequence[str], key: Tuple[str, ...]) -> str:
     return "{" + inner + "}"
 
 
+@locks.guarded
 class Counter:
     """Monotone float counter. `inc` only — a decrement is a bug."""
 
@@ -86,7 +89,7 @@ class Counter:
     def __init__(self, name: str, help: str = "") -> None:
         self.name = name
         self.help = help
-        self._value = 0.0
+        self._value = 0.0                           # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
@@ -100,6 +103,7 @@ class Counter:
         return self._value
 
 
+@locks.guarded
 class Gauge:
     """Point-in-time value; optionally backed by a callback (`set_fn`)
     read at snapshot/scrape time — how the HBM accountant exposes live
@@ -110,8 +114,8 @@ class Gauge:
     def __init__(self, name: str, help: str = "") -> None:
         self.name = name
         self.help = help
-        self._value = 0.0
-        self._fn: Optional[Callable[[], float]] = None
+        self._value = 0.0                           # guarded-by: _lock
+        self._fn: Optional[Callable[[], float]] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
@@ -138,6 +142,7 @@ class Gauge:
         return self._value
 
 
+@locks.guarded
 class Histogram:
     """Fixed log2-bucket latency histogram (milliseconds).
 
@@ -155,9 +160,10 @@ class Histogram:
         self.name = name
         self.help = help
         self.bounds = tuple(float(b) for b in bounds)
-        self._counts = [0] * (len(self.bounds) + 1)   # last = +Inf
-        self._sum = 0.0
-        self._count = 0
+        # last slot = +Inf
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded-by: _lock
+        self._sum = 0.0                               # guarded-by: _lock
+        self._count = 0                               # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, ms: float) -> None:
@@ -209,6 +215,7 @@ class Histogram:
         return self.bounds[-1]
 
 
+@locks.guarded
 class _Family:
     """Labeled instrument family: children cached per label-value tuple."""
 
@@ -220,7 +227,7 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._cls = cls
-        self._children: Dict[Tuple[str, ...], Any] = {}
+        self._children: Dict[Tuple[str, ...], Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def labels(self, **labels) -> Any:
@@ -242,6 +249,7 @@ class _Family:
 _KIND = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
 
 
+@locks.guarded
 class MetricsRegistry:
     """Ordered name -> instrument/family map with get-or-create semantics
     (re-declaring the same name with the same type returns the existing
@@ -249,7 +257,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._entries: Dict[str, Any] = {}
+        self._entries: Dict[str, Any] = {}          # guarded-by: _lock
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str]):

@@ -55,7 +55,7 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
     "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
-    "tpu_profile_capture",
+    "tpu_profile_capture", "tpu_debug_locks",
 })
 
 

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..obs import trace as obs_trace
+from ..utils import locks
 
 __all__ = ["RequestCoalescer"]
 
@@ -49,6 +50,7 @@ class _Req:
         self.future: Future = Future()
 
 
+@locks.guarded
 class RequestCoalescer:
     """SLO-aware batcher in front of a `ModelRegistry`."""
 
@@ -58,8 +60,8 @@ class RequestCoalescer:
         self.wait_s = max(float(max_batch_wait_ms), 0.0) / 1e3
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self._cv = threading.Condition()
-        self._queues: Dict[str, deque] = {}
-        self._closed = False
+        self._queues: Dict[str, deque] = {}         # guarded-by: _cv
+        self._closed = False                        # guarded-by: _cv
         self.batches = 0
         self.requests = 0
         self.rows = 0

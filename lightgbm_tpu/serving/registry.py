@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional
 from ..models.model_text import load_model_from_string
 from ..resilience.checkpoint import read_manifest
 from ..serve.engine import ForestEngine
-from ..utils import log
+from ..utils import locks, log
 
 __all__ = ["ModelEntry", "ModelRegistry", "load_checkpoint_model_text"]
 
@@ -98,6 +98,7 @@ class ModelEntry:
         self.buckets.add(self.engine._bucket(X.shape[0]))
 
 
+@locks.guarded
 class ModelRegistry:
     """Named ForestEngine pool with HBM-budget LRU eviction."""
 
@@ -107,9 +108,9 @@ class ModelRegistry:
         self.warm_rows = int(warm_rows)
         self.ledger = ledger
         self._lock = threading.RLock()
-        self._entries: Dict[str, ModelEntry] = {}
-        self._tick = 0                      # monotone LRU clock
-        self._last_used: Dict[str, int] = {}
+        self._entries: Dict[str, ModelEntry] = {}   # guarded-by: _lock
+        self._tick = 0      # guarded-by: _lock (monotone LRU clock)
+        self._last_used: Dict[str, int] = {}        # guarded-by: _lock
         self.loads = 0
         self.swaps = 0
         self.evictions = 0
@@ -126,10 +127,13 @@ class ModelRegistry:
                          if obs_metrics.enabled() else None)
 
     # -- notes -------------------------------------------------------------
-    def _note(self, what: str, **fields) -> None:
-        log.event(f"serve_{what}", **fields)
+    def _note(self, kind: str, **fields) -> None:
+        """One load/swap/evict note. Callers pass the FULL literal event
+        kind (catalogued in obs/events.py) so lint and grep both see it;
+        runtime validation in log.event covers this pass-through."""
+        log.event(kind, **fields)  # graftlint: disable=LGT005 kinds are caller literals, validated at runtime
         if self.ledger is not None:
-            self.ledger.commit(dict({"kind": "note", "note": f"serve_{what}"},
+            self.ledger.commit(dict({"kind": "note", "note": kind},
                                     **fields))
 
     # -- building ----------------------------------------------------------
@@ -187,9 +191,9 @@ class ModelRegistry:
             self.loads += 1
             if self._metrics is not None:
                 self._metrics.loads.inc()
-            self._note("load", model=name, version=version, source=source,
-                       bytes=entry.bytes, trees=entry.engine.num_trees,
-                       replaced=replacing)
+            self._note("serve_load", model=name, version=version,
+                       source=source, bytes=entry.bytes,
+                       trees=entry.engine.num_trees, replaced=replacing)
             self._evict_over_budget(protect=name)
         return entry
 
@@ -218,8 +222,9 @@ class ModelRegistry:
             self.swaps += 1
             if self._metrics is not None:
                 self._metrics.swaps.inc()
-            self._note("swap", model=name, version=version, source=source,
-                       bytes=entry.bytes, trees=entry.engine.num_trees,
+            self._note("serve_swap", model=name, version=version,
+                       source=source, bytes=entry.bytes,
+                       trees=entry.engine.num_trees,
                        old_version=old.version if old is not None else None)
             self._evict_over_budget(protect=name)
         return entry
@@ -268,11 +273,11 @@ class ModelRegistry:
             }
 
     # -- eviction ----------------------------------------------------------
-    def _touch(self, name: str) -> None:
+    def _touch(self, name: str) -> None:  # guarded-by: caller
         self._tick += 1
         self._last_used[name] = self._tick
 
-    def _evict_over_budget(self, protect: str) -> None:
+    def _evict_over_budget(self, protect: str) -> None:  # guarded-by: caller
         """Caller holds the lock. Evict LRU entries until the pool fits
         the budget; `protect` (the entry just installed) is exempt."""
         if self.hbm_budget_bytes <= 0:
@@ -292,6 +297,6 @@ class ModelRegistry:
             if self._metrics is not None:
                 self._metrics.evictions.inc()
             self.evicted.append(victim)
-            self._note("evict", model=victim, version=gone.version,
+            self._note("serve_evict", model=victim, version=gone.version,
                        bytes=gone.bytes, total_bytes=total,
                        budget=self.hbm_budget_bytes)

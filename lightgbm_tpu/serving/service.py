@@ -33,6 +33,12 @@ class ServingService:
                  ledger=None) -> None:
         cfg = Config.from_params(params or {})
         self.config = cfg
+        if cfg.tpu_debug_locks:
+            # install the checking __setattr__ BEFORE the registry/
+            # coalescer are constructed (their first guarded writes
+            # happen in __init__ and stay exempt either way)
+            from ..utils import locks
+            locks.set_debug_locks(True)
         # metrics must be on BEFORE the registry/coalescer resolve their
         # instrument handles (they bind once at construction)
         self.exporter = None

@@ -1,0 +1,166 @@
+"""Runtime lock-discipline assertions (`tpu_debug_locks`).
+
+graftlint's LGT004 checker enforces lock discipline STATICALLY: an
+attribute declared ``# guarded-by: _lock`` on its initializing
+assignment may only be mutated inside ``with self._lock``. This module
+is the dynamic twin for the cases lexical analysis can't see — calls
+through aliases, discipline violated only on a rare thread interleaving
+— used by the slow-gated serving concurrency stress test.
+
+Zero overhead when off: ``guarded`` only records the class and parses
+its ``guarded-by`` annotations (import-time, one regex pass over the
+class source). The checking ``__setattr__`` is installed ON the class
+only when ``set_debug_locks(True)`` runs (or the ``LGBT_DEBUG_LOCKS``
+environment variable is set), and uninstalled on disable, so production
+attribute writes stay C-speed slot/dict stores.
+
+What the runtime mode checks: REBINDING of a guarded attribute
+(``self._closed = True``) outside its lock. Container mutation through a
+held reference (``self._entries[k] = v``) does not pass through
+``__setattr__`` — that shape is LGT004's static job. Lock ownership is
+read via the lock's own ``_is_owned()`` (RLock and Condition both carry
+one); plain ``threading.Lock`` has no owner concept and degrades to
+``locked()`` (held by *someone*), which is still enough to catch the
+fully-unlocked mutation the stress test injects.
+
+Violations are RECORDED, not raised, by default (a raise inside a
+daemon flusher thread would be swallowed and the test would pass
+vacuously); ``violations()`` / ``assert_clean()`` are the test seam.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import threading
+from typing import Any, Dict, List, Tuple, Type
+
+__all__ = ["guarded", "set_debug_locks", "debug_locks_enabled",
+           "violations", "clear_violations", "assert_clean",
+           "guard_map_for"]
+
+_GUARD_RE = re.compile(
+    r"self\.(_\w+)\s*(?::[^=#\n]+)?=[^#\n]*#\s*guarded-by:\s*(_\w+)")
+
+_enabled = False
+_registered: List[Type] = []                 # classes seen by @guarded
+_guard_maps: Dict[Type, Dict[str, str]] = {}  # cls -> {attr: lockattr}
+_violations: List[str] = []
+_viol_lock = threading.Lock()
+
+
+def _parse_guard_map(cls: Type) -> Dict[str, str]:
+    """{attr: lockattr} from the class's `# guarded-by:` annotations.
+    Source unavailable (frozen app, REPL class) -> empty map: the mode
+    degrades to a no-op for that class rather than failing."""
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):
+        return {}
+    return {m.group(1): m.group(2) for m in _GUARD_RE.finditer(src)}
+
+
+def guarded(cls: Type) -> Type:
+    """Class decorator: register `cls` for the debug-lock mode. Free
+    when the mode is off — no wrapper, no metaclass, the class object
+    is returned unchanged."""
+    _guard_maps[cls] = _parse_guard_map(cls)
+    _registered.append(cls)
+    if _enabled:
+        _install(cls)
+    return cls
+
+
+def guard_map_for(cls: Type) -> Dict[str, str]:
+    """The parsed {attr: lockattr} map (tests + lint cross-checks)."""
+    return dict(_guard_maps.get(cls, {}))
+
+
+def _is_held(lock: Any) -> bool:
+    own = getattr(lock, "_is_owned", None)
+    if own is not None:
+        try:
+            return bool(own())
+        except Exception:
+            return True
+    locked = getattr(lock, "locked", None)
+    if locked is not None:
+        try:
+            return bool(locked())
+        except Exception:
+            return True
+    return True          # unknown lock type: never false-positive
+
+
+def _record(msg: str) -> None:
+    with _viol_lock:
+        _violations.append(msg)
+
+
+def _install(cls: Type) -> None:
+    if "__lgbt_plain_setattr__" in cls.__dict__:
+        return
+    guard = _guard_maps.get(cls, {})
+    plain = cls.__setattr__
+
+    def _checked_setattr(self, name, value,
+                         _guard=guard, _plain=plain, _cls=cls):
+        lockattr = _guard.get(name)
+        # first binding (during __init__) is exempt: the object is not
+        # shared yet and the lock itself may not exist
+        if lockattr is not None and hasattr(self, name):
+            lock = getattr(self, lockattr, None)
+            if lock is not None and not _is_held(lock):
+                _record(f"{_cls.__name__}.{name} rebound outside "
+                        f"`with self.{lockattr}` "
+                        f"(thread {threading.current_thread().name})")
+        _plain(self, name, value)
+
+    cls.__lgbt_plain_setattr__ = plain
+    cls.__setattr__ = _checked_setattr
+
+
+def _uninstall(cls: Type) -> None:
+    plain = cls.__dict__.get("__lgbt_plain_setattr__")
+    if plain is None:
+        return
+    if plain is object.__setattr__:
+        # the class never defined its own __setattr__: delete ours so
+        # attribute stores go back through the C slot
+        del cls.__setattr__
+    else:
+        cls.__setattr__ = plain
+    del cls.__lgbt_plain_setattr__
+
+
+def set_debug_locks(on: bool) -> None:
+    """Install (True) or remove (False) the checking __setattr__ on
+    every @guarded class. Idempotent."""
+    global _enabled
+    _enabled = bool(on)
+    for cls in _registered:
+        (_install if _enabled else _uninstall)(cls)
+
+
+def debug_locks_enabled() -> bool:
+    return _enabled
+
+
+def violations() -> List[str]:
+    with _viol_lock:
+        return list(_violations)
+
+
+def clear_violations() -> None:
+    with _viol_lock:
+        _violations.clear()
+
+
+def assert_clean() -> None:
+    got = violations()
+    assert not got, "lock-discipline violations:\n  " + "\n  ".join(got)
+
+
+if os.environ.get("LGBT_DEBUG_LOCKS", "").strip().lower() in (
+        "1", "on", "true", "yes"):
+    set_debug_locks(True)

@@ -68,12 +68,34 @@ def fatal(msg: str) -> None:
 _EVENT_PREFIX = "[LightGBM-TPU] [Event] "
 
 
+_validate_kind: Optional[Callable[[str], Optional[str]]] = None
+
+
+def _check_kind(kind: str) -> None:
+    """Assert `kind` is catalogued in obs/events.py. Import is lazy (log
+    loads before the obs package) and failures to import never block an
+    emit — the catalog is a debug net, not a runtime dependency."""
+    global _validate_kind
+    if _validate_kind is None:
+        try:
+            from ..obs.events import validate_kind
+        except ImportError:
+            return
+        _validate_kind = validate_kind
+    why = _validate_kind(kind)
+    assert why is None, why
+
+
 def event(kind: str, **fields: Any) -> None:
     """Structured channel: one machine-parseable JSON record through the
     same callback seam as the human lines (INFO level, so `verbosity=0`
     silences events exactly like info text). Human-facing lines stay
     unchanged — events are ADDITIONAL `[Event]`-tagged lines that
-    `parse_event` round-trips."""
+    `parse_event` round-trips. Kinds come from the closed catalog in
+    obs/events.py (asserted under ``__debug__``; graftlint's LGT005
+    enforces the same at lint time)."""
+    if __debug__:
+        _check_kind(kind)
     if _level >= INFO:
         rec = {"event": kind}
         rec.update(fields)

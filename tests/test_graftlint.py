@@ -1,0 +1,272 @@
+"""graftlint contract tests.
+
+Three layers:
+
+* the repo itself is CLEAN — `python -m tools.lint` exits 0 (this is
+  the tier-1 gate; a new finding fails CI here and in ci/test.sh);
+* every rule FIRES on an injected violation and stays quiet on a
+  minimal clean twin of the same shape (a rule that cannot fire is a
+  gate that guards nothing);
+* the reporting machinery round-trips: inline suppressions drop
+  findings, the baseline grandfathers exactly the recorded count, and
+  the JSON report carries the documented schema.
+
+Fixture trees reproduce only the path tails the rules anchor on
+(lightgbm_tpu/config.py, obs/events.py, ...) — `core.find_file` matches
+by suffix precisely so these tests don't need a full repo copy.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_tree(root, files):
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(src)
+
+
+def _run(root, *extra, paths=("lightgbm_tpu",)):
+    """(exit_code, report_dict) of a --json lint run over a fixture
+    tree; no baseline unless --baseline is passed in extra."""
+    cmd = [sys.executable, "-m", "tools.lint", "--json",
+           "--root", str(root), "--paths", *paths, *extra]
+    proc = subprocess.run(cmd, cwd=_REPO, capture_output=True,
+                          text=True)
+    assert proc.stdout, proc.stderr
+    return proc.returncode, json.loads(proc.stdout)
+
+
+def _rules_hit(report):
+    return sorted({f["rule"] for f in report["new"]})
+
+
+# ---------------------------------------------------------------------------
+# the real tree is clean — the actual CI gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    proc = subprocess.run([sys.executable, "-m", "tools.lint"],
+                          cwd=_REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout
+
+
+def test_repo_baseline_is_empty_for_lgt001_lgt002():
+    # policy: signature and fence findings are fixed, never baselined
+    with open(os.path.join(_REPO, "tools", "lint",
+                           "baseline.json")) as fh:
+        doc = json.load(fh)
+    grandfathered = {rec["rule"] for rec in doc.get("findings", [])}
+    assert "LGT001" not in grandfathered
+    assert "LGT002" not in grandfathered
+
+
+# ---------------------------------------------------------------------------
+# per-rule: injected violation fires, clean twin does not
+# ---------------------------------------------------------------------------
+
+_SIG_COMMON = {
+    "lightgbm_tpu/config.py": (
+        "from dataclasses import dataclass\n\n"
+        "@dataclass\nclass Config:\n"
+        "    tpu_alpha: int = 1\n"
+        "    tpu_beta: int = 2\n"
+        "    tpu_gamma: bool = False\n"),
+    "lightgbm_tpu/compile_cache.py": (
+        "def config_signature(cfg):\n"
+        "    names = ['tpu_alpha', 'tpu_beta']\n"
+        "    return tuple((n, getattr(cfg, n)) for n in names)\n"),
+    "lightgbm_tpu/models/model_text.py": (
+        "_RUNTIME_ONLY_PARAMS = frozenset({'tpu_gamma'})\n"),
+}
+
+_EVENTS = {"lightgbm_tpu/obs/events.py":
+           "EVENTS = {'good_kind': 'a fine event'}\n"}
+
+_CASES = {
+    "LGT001": (
+        # tpu_gamma dropped from the runtime set: now in NEITHER door
+        dict(_SIG_COMMON, **{
+            "lightgbm_tpu/resilience/checkpoint.py":
+                "RUNTIME_ONLY_PARAMS = frozenset({'tpu_delta'})\n"}),
+        dict(_SIG_COMMON, **{
+            "lightgbm_tpu/resilience/checkpoint.py":
+                "RUNTIME_ONLY_PARAMS = frozenset({'tpu_gamma'})\n"}),
+    ),
+    "LGT002": (
+        {"lightgbm_tpu/a.py": (
+            "import jax\n\n"
+            "def wait(x):\n"
+            "    return jax.block_until_ready(x)\n")},
+        {"lightgbm_tpu/a.py": (
+            "from .obs import trace as obs_trace\n\n"
+            "def wait(x):\n"
+            "    return obs_trace.force_fence(x)\n")},
+    ),
+    "LGT003": (
+        {"lightgbm_tpu/a.py": (
+            "import jax\n\n"
+            "def g(a):\n    return a + 1\n\n"
+            "def run(x):\n"
+            "    fn = jax.jit(g, donate_argnums=(0,))\n"
+            "    y = fn(x)\n"
+            "    return x + y\n")},
+        {"lightgbm_tpu/a.py": (
+            "import jax\n\n"
+            "def g(a):\n    return a + 1\n\n"
+            "def run(x):\n"
+            "    fn = jax.jit(g, donate_argnums=(0,))\n"
+            "    x = fn(x)\n"
+            "    return x + 1\n")},
+    ),
+    "LGT004": (
+        {"lightgbm_tpu/a.py": (
+            "import threading\n\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []        # guarded-by: _lock\n\n"
+            "    def put(self, v):\n"
+            "        self._items.append(v)\n")},
+        {"lightgbm_tpu/a.py": (
+            "import threading\n\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []        # guarded-by: _lock\n\n"
+            "    def put(self, v):\n"
+            "        with self._lock:\n"
+            "            self._items.append(v)\n")},
+    ),
+    "LGT005": (
+        dict(_EVENTS, **{"lightgbm_tpu/a.py": (
+            "from .utils import log\n\n"
+            "def emit():\n"
+            "    log.event('bogus_kind', n=1)\n")}),
+        dict(_EVENTS, **{"lightgbm_tpu/a.py": (
+            "from .utils import log\n\n"
+            "def emit():\n"
+            "    log.event('good_kind', n=1)\n")}),
+    ),
+    "LGT006": (
+        {"lightgbm_tpu/a.py": (
+            "import time\nimport jax\n\n"
+            "def step(a):\n"
+            "    return a + time.time()\n\n"
+            "prog = jax.jit(step)\n")},
+        {"lightgbm_tpu/a.py": (
+            "import time\nimport jax\n\n"
+            "def step(a):\n"
+            "    return a + 1.0\n\n"
+            "prog = jax.jit(step)\n"
+            "t0 = time.time()\n")},   # impurity OUTSIDE the trace: fine
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_CASES))
+def test_rule_fires_on_injected_violation(rule, tmp_path):
+    bad, _good = _CASES[rule]
+    _write_tree(tmp_path, bad)
+    code, report = _run(tmp_path)
+    assert code == 1
+    assert rule in _rules_hit(report), report["new"]
+
+
+@pytest.mark.parametrize("rule", sorted(_CASES))
+def test_rule_quiet_on_clean_twin(rule, tmp_path):
+    _bad, good = _CASES[rule]
+    _write_tree(tmp_path, good)
+    code, report = _run(tmp_path, "--rule", rule)
+    assert code == 0, report["new"]
+    assert report["new"] == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / baseline / schema machinery
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_drops_finding(tmp_path):
+    bad, _ = _CASES["LGT002"]
+    src = bad["lightgbm_tpu/a.py"].replace(
+        "jax.block_until_ready(x)",
+        "jax.block_until_ready(x)  "
+        "# graftlint: disable=LGT002 timing barrier in a throwaway")
+    _write_tree(tmp_path, {"lightgbm_tpu/a.py": src})
+    code, report = _run(tmp_path)
+    assert code == 0
+    assert report["counts"]["suppressed"] == 1
+    assert report["suppressed"][0]["rule"] == "LGT002"
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    _write_tree(tmp_path, {"lightgbm_tpu/a.py": (
+        "import jax\n\n"
+        "def wait(x):\n"
+        "    # graftlint: disable=LGT002 standalone-comment form\n"
+        "    return jax.block_until_ready(x)\n")})
+    code, report = _run(tmp_path)
+    assert code == 0
+    assert report["counts"]["suppressed"] == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    bad, _ = _CASES["LGT004"]
+    _write_tree(tmp_path, bad)
+    bl = str(tmp_path / "bl.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--root", str(tmp_path),
+         "--paths", "lightgbm_tpu", "--baseline", bl,
+         "--update-baseline"],
+        cwd=_REPO, capture_output=True, text=True)
+    assert proc.returncode == 0 and os.path.isfile(bl), proc.stderr
+
+    # grandfathered: same tree is now green, finding counted as old
+    code, report = _run(tmp_path, "--baseline", bl)
+    assert code == 0
+    assert report["counts"]["baselined"] == 1
+    assert report["new"] == []
+
+    # a NEW violation alongside the baselined one still gates
+    extra = bad["lightgbm_tpu/a.py"] + (
+        "\n    def drop(self):\n"
+        "        self._items.clear()\n")
+    _write_tree(tmp_path, {"lightgbm_tpu/a.py": extra})
+    code, report = _run(tmp_path, "--baseline", bl)
+    assert code == 1
+    assert report["counts"]["baselined"] == 1
+    assert len(report["new"]) == 1
+    assert report["new"][0]["rule"] == "LGT004"
+
+
+def test_json_report_schema(tmp_path):
+    bad, _ = _CASES["LGT006"]
+    _write_tree(tmp_path, bad)
+    code, report = _run(tmp_path)
+    assert code == 1
+    assert report["schema"] == 1
+    assert set(report) >= {"schema", "files_scanned", "rules", "new",
+                           "baselined", "suppressed", "counts"}
+    assert report["rules"] == ["LGT001", "LGT002", "LGT003", "LGT004",
+                               "LGT005", "LGT006"]
+    f = report["new"][0]
+    assert set(f) == {"rule", "path", "line", "message", "fingerprint"}
+    assert f["path"].startswith("lightgbm_tpu/")
+    assert isinstance(f["line"], int) and f["line"] > 0
+    assert len(f["fingerprint"]) == 16
+    assert report["counts"]["new"] == len(report["new"])
+
+
+def test_parse_error_gates(tmp_path):
+    _write_tree(tmp_path, {"lightgbm_tpu/a.py": "def broken(:\n"})
+    code, report = _run(tmp_path)
+    assert code == 1
+    assert report["new"][0]["rule"] == "LGT000"

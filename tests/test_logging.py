@@ -1,6 +1,7 @@
 """Leveled logger (reference utils/log.h:37-48 + the C API log callback).
 """
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.utils.log import (event, parse_event, register_callback,
@@ -44,8 +45,13 @@ def test_event_channel_roundtrip():
         # events ride the INFO level: silenced at verbosity < 1
         set_verbosity(0)
         n = len(lines)
-        event("hidden", x=1)
+        event("train_path", x=1)
         assert len(lines) == n
+        # the kind vocabulary is closed (obs/events.py): an
+        # uncatalogued kind asserts under __debug__ instead of
+        # silently never matching any consumer
+        with pytest.raises(AssertionError, match="unknown event kind"):
+            event("not_a_catalogued_kind", x=1)
     finally:
         register_callback(None)
         set_verbosity(1)

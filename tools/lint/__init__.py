@@ -1,0 +1,4 @@
+"""graftlint: AST-based invariant checker for this repo's discipline
+rules (signature completeness, fence/lock/donation hygiene, vocabulary
+drift, trace purity). Run `python -m tools.lint` from the repo root;
+see docs/Linting.md for the rule catalog and suppression policy."""

@@ -1,0 +1,85 @@
+"""graftlint driver: `python -m tools.lint` from the repo root.
+
+Exit 0 when the tree is clean (modulo inline suppressions and the
+checked-in baseline), 1 when there are NEW findings or unparseable
+files. `--update-baseline` rewrites tools/lint/baseline.json from the
+current findings — policy: only for LGT003..LGT006 debt you have a plan
+for; LGT001/LGT002 findings are always fixed, never baselined
+(docs/Linting.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from . import core
+from .rules import ALL_RULES, RULE_IDS
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: repo invariant checker "
+                    f"({', '.join(RULE_IDS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: cwd, or the tree above "
+                         "this package when cwd is elsewhere)")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="scan roots relative to --root "
+                         f"(default: {' '.join(core.DEFAULT_SCAN)})")
+    ap.add_argument("--rule", action="append", choices=RULE_IDS,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="parse workers (0 = auto, 1 = serial)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "<root>/tools/lint/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    args = ap.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        root = os.getcwd()
+        if not os.path.isdir(os.path.join(root, "tools", "lint")):
+            root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+    scan = args.paths if args.paths else core.DEFAULT_SCAN
+    paths = core.collect_paths(root, scan)
+    files = core.load_files(root, paths, jobs=args.jobs)
+
+    rules = [m for m in ALL_RULES
+             if not args.rule or m.RULE in args.rule]
+    findings: List[core.Finding] = list(core.parse_errors(files))
+    for mod in rules:
+        findings.extend(mod.check(files))
+
+    kept, suppressed = core.apply_suppressions(files, findings)
+
+    bl_path = args.baseline or core.baseline_path(root)
+    if args.update_baseline:
+        core.write_baseline(bl_path, kept)
+        print(f"graftlint: baseline rewritten with {len(kept)} "
+              f"finding(s) -> {bl_path}")
+        return 0
+
+    baseline = core.load_baseline(bl_path)
+    new, baselined = core.split_new(kept, baseline)
+
+    if args.json:
+        print(json.dumps(core.report_json(
+            files, new, baselined, suppressed,
+            [m.RULE for m in rules]), indent=1, sort_keys=True))
+    else:
+        print(core.report_text(files, new, baselined, suppressed))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""Rule registry. Each rule module exports RULE (its LGT id), TITLE,
+and check(files) -> List[Finding]; the driver runs them all unless
+--rule narrows the set. Adding a rule = adding a module here and one
+line to ALL_RULES (plus a fixture pair in tests/test_graftlint.py)."""
+from __future__ import annotations
+
+from . import (lgt001_signature, lgt002_fence, lgt003_donation,
+               lgt004_locks, lgt005_vocab, lgt006_purity)
+
+ALL_RULES = [lgt001_signature, lgt002_fence, lgt003_donation,
+             lgt004_locks, lgt005_vocab, lgt006_purity]
+
+RULE_IDS = [m.RULE for m in ALL_RULES]

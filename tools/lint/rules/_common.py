@@ -1,0 +1,121 @@
+"""Shared AST helpers for the rule modules."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains ("jax.jit",
+    "self._entries"); None when the chain roots in something else
+    (a call result, a subscript)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def literal_str_elts(node: ast.AST) -> Optional[Set[str]]:
+    """String elements of a set/list/tuple/frozenset(...) literal, or
+    the keys of a dict literal; None when it is anything else."""
+    if isinstance(node, ast.Call) and len(node.args) == 1 and \
+            attr_chain(node.func) in ("frozenset", "set", "tuple", "list"):
+        node = node.args[0]
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        out = set()
+        for elt in node.elts:
+            s = str_const(elt)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+    if isinstance(node, ast.Dict):
+        out = set()
+        for key in node.keys:
+            s = str_const(key) if key is not None else None
+            if s is None:
+                return None
+            out.add(s)
+        return out
+    return None
+
+
+def module_assign(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    """The value expression of the module-level `name = ...` /
+    `name: T = ...` binding, or None."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) and \
+                    node.target.id == name and node.value is not None:
+                return node.value
+    return None
+
+
+def find_class(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def find_def(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def import_aliases(tree: ast.AST, module: str) -> Set[str]:
+    """Local names bound to `module` by import statements ("np" for
+    `import numpy as np`; "time" for `import time`)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module or a.name.startswith(module + "."):
+                    out.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == module:
+                continue  # from-imports handled by callers that care
+    return out
+
+
+def from_import_aliases(tree: ast.AST, module: str,
+                        name: str) -> Set[str]:
+    """Local names bound by `from module import name [as alias]`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                (node.module == module or
+                 node.module.endswith("." + module)):
+            for a in node.names:
+                if a.name == name:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def walk_no_nested_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class
+    definitions or lambdas (scope barrier)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

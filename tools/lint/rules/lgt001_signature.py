@@ -1,0 +1,150 @@
+"""LGT001 — signature completeness.
+
+Every `tpu_*` Config field participates in compile-cache keying through
+exactly one door: either it is part of `compile_cache.config_signature`
+(so changing it forces a re-trace) or it is declared runtime-only
+(checkpoint.RUNTIME_ONLY_PARAMS, and for model-text round-tripping
+model_text._RUNTIME_ONLY_PARAMS). A field in NEITHER is the latent
+stale-cache bug this repo has already shipped once: a new knob changes
+the traced computation but two configs differing only in it share a
+cached program. A field in BOTH (when the signature is a hand-written
+list) is a contradiction — runtime-only params must not perturb cache
+keys or checkpoint-resume compatibility hashes.
+
+The current `config_signature` iterates `dataclasses.fields(cfg)`, so
+membership is automatic and the live checks reduce to:
+
+* every name in a runtime-only set must be a real Config field (a typo
+  or a renamed field silently stops being excluded);
+* `model_text._RUNTIME_ONLY_PARAMS` must be a subset of the checkpoint
+  set (model-text exclusion without signature exclusion would make a
+  saved model's params differ from its own resume signature).
+
+If someone rewrites config_signature as an explicit field list, this
+rule detects the loss of the `dataclasses.fields` call and switches to
+per-field exactly-one enforcement against the listed names.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileInfo, Finding, find_file
+from . import _common
+
+RULE = "LGT001"
+TITLE = "signature completeness"
+
+
+def _config_fields(fi: FileInfo) -> Dict[str, int]:
+    """tpu_* field name -> declaration line in class Config."""
+    cls = _common.find_class(fi.tree, "Config")
+    if cls is None:
+        return {}
+    out: Dict[str, int] = {}
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            name = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+        else:
+            continue
+        if name.startswith("tpu_"):
+            out[name] = node.lineno
+    return out
+
+
+def _runtime_set(fi: Optional[FileInfo],
+                 var: str) -> Tuple[Optional[Set[str]], int]:
+    if fi is None or fi.tree is None:
+        return None, 1
+    node = _common.module_assign(fi.tree, var)
+    if node is None:
+        return None, 1
+    return _common.literal_str_elts(node), node.lineno
+
+
+def _signature_mode(fi: Optional[FileInfo]) -> Tuple[str, Set[str], int]:
+    """("auto"|"manual"|"missing", listed-names, lineno)."""
+    if fi is None or fi.tree is None:
+        return "missing", set(), 1
+    fn = _common.find_def(fi.tree, "config_signature")
+    if fn is None:
+        return "missing", set(), 1
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _common.attr_chain(node.func) or ""
+            if chain == "fields" or chain.endswith(".fields"):
+                return "auto", set(), fn.lineno
+        s = _common.str_const(node)
+        if s is not None and s.isidentifier():
+            names.add(s)
+    return "manual", names, fn.lineno
+
+
+def check(files: List[FileInfo]) -> List[Finding]:
+    cfg = find_file(files, "lightgbm_tpu/config.py")
+    if cfg is None or cfg.tree is None:
+        return []
+    fields = _config_fields(cfg)
+    if not fields:
+        return []
+    out: List[Finding] = []
+
+    ckpt = find_file(files, "resilience/checkpoint.py")
+    mtxt = find_file(files, "models/model_text.py")
+    ck_set, ck_line = _runtime_set(ckpt, "RUNTIME_ONLY_PARAMS")
+    mt_set, mt_line = _runtime_set(mtxt, "_RUNTIME_ONLY_PARAMS")
+
+    for name, rt_set, rt_line, rt_fi, label in (
+            ("RUNTIME_ONLY_PARAMS", ck_set, ck_line, ckpt,
+             "checkpoint"),
+            ("_RUNTIME_ONLY_PARAMS", mt_set, mt_line, mtxt,
+             "model_text")):
+        if rt_set is None or rt_fi is None:
+            continue
+        for p in sorted(rt_set):
+            if p.startswith("tpu_") and p not in fields:
+                out.append(Finding(
+                    RULE, rt_fi.relpath, rt_line,
+                    f"{label} {name} lists {p!r} which is not a "
+                    f"Config field (typo or renamed field — it "
+                    f"excludes nothing)"))
+
+    if mt_set is not None and ck_set is not None and mtxt is not None:
+        for p in sorted(mt_set - ck_set):
+            out.append(Finding(
+                RULE, mtxt.relpath, mt_line,
+                f"model_text runtime-only param {p!r} is missing from "
+                f"checkpoint RUNTIME_ONLY_PARAMS — saved-model params "
+                f"would diverge from the resume signature"))
+
+    cc = find_file(files, "lightgbm_tpu/compile_cache.py")
+    mode, listed, _sig_line = _signature_mode(cc)
+    if mode == "missing" and cc is not None:
+        out.append(Finding(
+            RULE, cc.relpath, 1,
+            "compile_cache.config_signature not found — signature "
+            "completeness cannot be established"))
+    elif mode == "manual":
+        rt = ck_set or set()
+        for name, line in sorted(fields.items()):
+            in_sig = name in listed
+            in_rt = name in rt
+            if not in_sig and not in_rt:
+                out.append(Finding(
+                    RULE, cfg.relpath, line,
+                    f"Config field {name!r} is in neither "
+                    f"config_signature nor RUNTIME_ONLY_PARAMS — "
+                    f"latent stale-cache bug"))
+            elif in_sig and in_rt:
+                out.append(Finding(
+                    RULE, cfg.relpath, line,
+                    f"Config field {name!r} is in BOTH "
+                    f"config_signature and RUNTIME_ONLY_PARAMS — "
+                    f"contradiction (runtime-only params must not "
+                    f"perturb cache keys)"))
+    return out

@@ -1,0 +1,48 @@
+"""LGT002 — fence discipline.
+
+`jax.block_until_ready` outside `obs/trace.py` is banned. The trace
+module wraps it as `fence()` (active only while tracing, so production
+paths stay async) and `force_fence()` (benchmark timing barriers); a
+raw call anywhere else either serializes a hot path unconditionally or
+times a dispatch instead of a computation. Five tools/ scripts had
+exactly this bug before this rule existed.
+
+Flags any `*.block_until_ready` attribute use and any bare
+`block_until_ready` name (from-import) in every scanned file except
+obs/trace.py, which is the single sanctioned wrapper site.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import FileInfo, Finding
+
+RULE = "LGT002"
+TITLE = "fence discipline"
+
+_EXEMPT_SUFFIX = "obs/trace.py"
+
+
+def check(files: List[FileInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in files:
+        if fi.tree is None or fi.relpath.endswith(_EXEMPT_SUFFIX):
+            continue
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "block_until_ready":
+                out.append(Finding(
+                    RULE, fi.relpath, node.lineno,
+                    "direct block_until_ready — use "
+                    "obs.trace.fence()/force_fence() (the only "
+                    "sanctioned sync sites)"))
+            elif isinstance(node, ast.Name) and \
+                    node.id == "block_until_ready" and \
+                    isinstance(node.ctx, ast.Load):
+                out.append(Finding(
+                    RULE, fi.relpath, node.lineno,
+                    "imported block_until_ready — use "
+                    "obs.trace.fence()/force_fence() (the only "
+                    "sanctioned sync sites)"))
+    return out

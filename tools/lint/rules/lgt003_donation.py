@@ -1,0 +1,228 @@
+"""LGT003 — donation safety.
+
+`jax.jit(f, donate_argnums=...)` invalidates the caller's buffer at the
+donated position: after the dispatch, reading that local is
+use-after-donate — on TPU it raises at best and silently reads a
+reused buffer at worst. The builder's `train_iter` threads this
+carefully (`fn(self.rec, ...)` then reassigns `self.rec` from the
+outputs); this rule keeps every other call site as careful.
+
+Per function, a linear statement scan tracks which locals / self-attrs
+were passed in a donated arg position of a known donating dispatch:
+
+* donating dispatches: locals assigned from `jax.jit(g, donate_argnums=
+  ...)` or `self._program(..., donate=(...))`, plus module-level defs
+  decorated `@jax.jit(...)` / `@functools.partial(jax.jit,
+  donate_argnums=...)`;
+* after the dispatch, any Load of a tracked name (including AugAssign
+  and `return x`) is a finding until a plain store rebinds it;
+* `with` bodies are inlined into the parent's linear flow (the real
+  dispatches sit inside `obs_trace.span(...)` blocks); other compound
+  statements are opaque — reads inside them are still checked, stores
+  inside them conservatively clear, but donations registered inside
+  them are ignored (a conditional donation must not poison the
+  fall-through path).
+
+Nested defs are scanned as their own functions (fresh state): closure
+reads of an outer donated buffer are rare and too alias-heavy to check
+soundly without dataflow.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import FileInfo, Finding
+from . import _common
+
+RULE = "LGT003"
+TITLE = "donation safety"
+
+Key = Tuple[str, str]          # ("n", local) | ("s", self-attr)
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _jit_donate(call: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate positions of a `jax.jit(...)` / `functools.partial(
+    jax.jit, ...)` expression, None when it is not one or donates
+    nothing."""
+    if not isinstance(call, ast.Call):
+        return None
+    chain = _common.attr_chain(call.func) or ""
+    if chain.endswith("partial") and call.args and \
+            (_common.attr_chain(call.args[0]) or "").endswith("jit"):
+        pass
+    elif not (chain == "jit" or chain.endswith(".jit")):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _int_tuple(kw.value)
+    return None
+
+
+def _program_donate(call: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate positions of a `self._program(key, factory, donate=...)`
+    registry dispatch."""
+    if not isinstance(call, ast.Call):
+        return None
+    chain = _common.attr_chain(call.func) or ""
+    if not (chain.endswith("._program") or chain == "_program"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate":
+            return _int_tuple(kw.value)
+    return None
+
+
+def _store_key(node: ast.AST) -> Optional[Key]:
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return ("s", node.attr)
+    return None
+
+
+def _module_donators(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                pos = _jit_donate(dec)
+                if pos:
+                    out[node.name] = pos
+    return out
+
+
+class _FnScan:
+    def __init__(self, fi: FileInfo, fname: str,
+                 module_donators: Dict[str, Tuple[int, ...]]) -> None:
+        self.fi = fi
+        self.fname = fname
+        self.module_donators = module_donators
+        self.local_donators: Dict[str, Tuple[int, ...]] = {}
+        self.attr_donators: Dict[str, Tuple[int, ...]] = {}
+        self.tracked: Dict[Key, str] = {}   # key -> dispatch description
+        self.findings: List[Finding] = []
+
+    # -- reads --------------------------------------------------------------
+    def _check_reads(self, node: ast.AST) -> None:
+        for n in _common.walk_no_nested_defs(node):
+            key: Optional[Key] = None
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                key = ("n", n.id)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.ctx, ast.Load) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == "self":
+                key = ("s", n.attr)
+            if key is not None and key in self.tracked:
+                what = (f"self.{key[1]}" if key[0] == "s" else key[1])
+                self.findings.append(Finding(
+                    RULE, self.fi.relpath, n.lineno,
+                    f"{what} read in {self.fname} after being donated "
+                    f"to {self.tracked[key]} — its buffer is invalid"))
+
+    # -- donating dispatch registration -------------------------------------
+    def _register_assign(self, stmt: ast.Assign) -> None:
+        if len(stmt.targets) != 1:
+            return
+        pos = _jit_donate(stmt.value) or _program_donate(stmt.value)
+        if not pos:
+            return
+        key = _store_key(stmt.targets[0])
+        if key is None:
+            return
+        if key[0] == "n":
+            self.local_donators[key[1]] = pos
+        else:
+            self.attr_donators[key[1]] = pos
+
+    def _track_calls(self, node: ast.AST) -> None:
+        for n in _common.walk_no_nested_defs(node):
+            if not isinstance(n, ast.Call):
+                continue
+            pos: Optional[Tuple[int, ...]] = None
+            desc = ""
+            if isinstance(n.func, ast.Name):
+                pos = self.local_donators.get(n.func.id) \
+                    or self.module_donators.get(n.func.id)
+                desc = f"{n.func.id}(...)"
+            elif isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == "self":
+                pos = self.attr_donators.get(n.func.attr)
+                desc = f"self.{n.func.attr}(...)"
+            if not pos:
+                continue
+            if any(isinstance(a, ast.Starred) for a in n.args):
+                continue            # *args splat: positions unmappable
+            for p in pos:
+                if p >= len(n.args):
+                    continue
+                key = _store_key(n.args[p])
+                if key is not None:
+                    self.tracked[key] = f"{desc} (arg {p}, donated)"
+
+    # -- stores -------------------------------------------------------------
+    def _clear_stores(self, node: ast.AST) -> None:
+        for n in _common.walk_no_nested_defs(node):
+            if isinstance(n, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(n, "ctx", None),
+                               (ast.Store, ast.Del)):
+                key = _store_key(n)
+                if key is not None:
+                    self.tracked.pop(key, None)
+
+    # -- statement walk -----------------------------------------------------
+    def scan(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._check_reads(item.context_expr)
+                self.scan(stmt.body)
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        self._clear_stores(item.optional_vars)
+                continue
+            self._check_reads(stmt)
+            if isinstance(stmt, (ast.If, ast.For, ast.While, ast.Try)):
+                # opaque: conditional donations are ignored, stores
+                # anywhere inside conservatively clear
+                self._clear_stores(stmt)
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._register_assign(stmt)
+            self._track_calls(stmt)
+            self._clear_stores(stmt)
+
+
+def check(files: List[FileInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in files:
+        if fi.tree is None:
+            continue
+        module_donators = _module_donators(fi.tree)
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.FunctionDef):
+                scan = _FnScan(fi, node.name, module_donators)
+                scan.scan(node.body)
+                out.extend(scan.findings)
+    return out

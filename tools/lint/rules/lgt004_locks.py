@@ -1,0 +1,256 @@
+"""LGT004 — lock discipline.
+
+Shared mutable state in the serving plane and the obs registries is
+annotated at its declaration with a trailing ``# guarded-by: <lock>``
+comment (`self._entries ... # guarded-by: _lock`, module-level
+`_owners ... # guarded-by: _lock`). This rule enforces what the
+annotation promises: every MUTATION of an annotated target — rebinding,
+augmented assignment, item store/delete, or a mutator-method call
+(`.append`, `.pop`, `.setdefault`, ...) — must sit lexically inside
+``with self.<lock>:`` (or ``with <lock>:`` for module globals).
+
+Conventions:
+
+* the rule activates per annotation — files without ``guarded-by``
+  comments are untouched, and deliberately unannotated state (the
+  watcher's single-thread fields, metrics' `_enabled` flip) stays out;
+* ``# guarded-by: caller`` on a ``def`` line exempts that method — it
+  documents a helper whose CALLERS hold the lock (`_touch`,
+  `_evict_over_budget`);
+* ``__init__`` and module top-level are exempt (construction precedes
+  sharing);
+* nested defs restart with no locks held (they run later, on someone
+  else's stack).
+
+Reads are NOT checked — several read paths are deliberately lock-free —
+and aliasing (`q = self._queues[m]; q.append(...)`) is out of scope;
+the runtime twin (`tpu_debug_locks`, utils/locks.py) catches rebinding
+races the static scan cannot see, and this scan catches container
+mutations the runtime `__setattr__` hook cannot see.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileInfo, Finding
+
+RULE = "LGT004"
+TITLE = "lock discipline"
+
+_DECL_RE = re.compile(r"guarded-by:\s*(_\w+|caller)")
+_SELF_DECL_RE = re.compile(r"self\.(_\w+)\s*(?::[^=#\n]+)?=(?!=)")
+_MOD_DECL_RE = re.compile(r"^(_\w+)\s*(?::[^=#\n]+)?=(?!=)")
+
+MUTATORS = {"append", "appendleft", "add", "clear", "extend", "insert",
+            "pop", "popleft", "popitem", "remove", "discard",
+            "setdefault", "update", "sort", "reverse"}
+
+
+def _decls(fi: FileInfo) -> Tuple[Dict[int, Tuple[str, str]],
+                                  Dict[str, str]]:
+    """(line -> (self-attr, lock)) and (module-global -> lock)."""
+    attr_decls: Dict[int, Tuple[str, str]] = {}
+    mod_decls: Dict[str, str] = {}
+    for line, comment in fi.comments.items():
+        m = _DECL_RE.search(comment)
+        if not m or m.group(1) == "caller":
+            continue
+        code = fi.lines[line - 1] if line - 1 < len(fi.lines) else ""
+        sm = _SELF_DECL_RE.search(code)
+        if sm:
+            attr_decls[line] = (sm.group(1), m.group(1))
+            continue
+        mm = _MOD_DECL_RE.match(code.strip())
+        if mm:
+            mod_decls[mm.group(1)] = m.group(1)
+    return attr_decls, mod_decls
+
+
+def _caller_exempt(fi: FileInfo, fn: ast.FunctionDef) -> bool:
+    first = fn.body[0].lineno if fn.body else fn.lineno
+    for line in range(fn.lineno, first + 1):
+        c = fi.comments.get(line, "")
+        if "guarded-by" in c and "caller" in c:
+            return True
+    return False
+
+
+def _with_locks(stmt: ast.With) -> Set[str]:
+    out: Set[str] = set()
+    for item in stmt.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and \
+                isinstance(e.value, ast.Name) and e.value.id == "self":
+            out.add(e.attr)
+        elif isinstance(e, ast.Name):
+            out.add(e.id)
+    return out
+
+
+class _Scan:
+    def __init__(self, fi: FileInfo, fname: str,
+                 guard: Dict[str, str], mod_guard: Dict[str, str],
+                 globals_decl: Set[str]) -> None:
+        self.fi = fi
+        self.fname = fname
+        self.guard = guard            # self-attr -> lock (class form)
+        self.mod_guard = mod_guard    # module global -> lock
+        self.globals_decl = globals_decl
+        self.findings: List[Finding] = []
+
+    def _target_lock(self, node: ast.AST,
+                     rebind: bool) -> Optional[Tuple[str, str]]:
+        """(description, required-lock) when `node` names a guarded
+        target; rebind=True for plain Name stores (module form needs a
+        `global` declaration for those to be a shared mutation)."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in self.guard:
+            return f"self.{node.attr}", self.guard[node.attr]
+        if isinstance(node, ast.Name) and node.id in self.mod_guard:
+            if rebind and node.id not in self.globals_decl:
+                return None
+            return node.id, self.mod_guard[node.id]
+        return None
+
+    def _flag(self, line: int, what: str, lock: str, how: str) -> None:
+        held_as = f"self.{lock}" if what.startswith("self.") else lock
+        self.findings.append(Finding(
+            RULE, self.fi.relpath, line,
+            f"{what} {how} in {self.fname} outside "
+            f"`with {held_as}:` (declared guarded-by: {lock})"))
+
+    def _check_node(self, node: ast.AST, held: Set[str]) -> None:
+        """Mutator-method calls and item stores anywhere in `node`."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in MUTATORS:
+                got = self._target_lock(n.func.value, rebind=False)
+                if got and got[1] not in held:
+                    self._flag(n.lineno, got[0], got[1],
+                               f".{n.func.attr}(...) called")
+            if isinstance(n, ast.Subscript) and \
+                    isinstance(n.ctx, (ast.Store, ast.Del)):
+                got = self._target_lock(n.value, rebind=False)
+                if got and got[1] not in held:
+                    self._flag(n.lineno, got[0], got[1],
+                               "item assigned" if isinstance(
+                                   n.ctx, ast.Store) else "item deleted")
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_stmt(self, stmt: ast.stmt, held: Set[str]) -> None:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for tgt in targets:
+            nodes = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                 ast.List)) else [tgt]
+            for n in nodes:
+                got = self._target_lock(n, rebind=True)
+                if got and got[1] not in held:
+                    self._flag(stmt.lineno, got[0], got[1], "rebound")
+        self._check_node(stmt, held)
+
+    def scan(self, stmts: List[ast.stmt], held: Set[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.scan(stmt.body, set())     # runs later, lock-free
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            if isinstance(stmt, ast.With):
+                inner = held | _with_locks(stmt)
+                self.scan(stmt.body, inner)
+                continue
+            if isinstance(stmt, ast.If):
+                self._check_node(stmt.test, held)
+                self.scan(stmt.body, held)
+                self.scan(stmt.orelse, held)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                self._check_node(stmt.iter if isinstance(stmt, ast.For)
+                                 else stmt.test, held)
+                self.scan(stmt.body, held)
+                self.scan(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Try):
+                self.scan(stmt.body, held)
+                for h in stmt.handlers:
+                    self.scan(h.body, held)
+                self.scan(stmt.orelse, held)
+                self.scan(stmt.finalbody, held)
+                continue
+            self._check_stmt(stmt, held)
+
+
+def _class_guard_maps(fi: FileInfo,
+                      attr_decls: Dict[int, Tuple[str, str]]
+                      ) -> Dict[ast.ClassDef, Dict[str, str]]:
+    classes = [n for n in ast.walk(fi.tree)
+               if isinstance(n, ast.ClassDef)]
+    out: Dict[ast.ClassDef, Dict[str, str]] = {}
+    for line, (attr, lock) in attr_decls.items():
+        best = None
+        for cls in classes:
+            end = getattr(cls, "end_lineno", cls.lineno)
+            if cls.lineno <= line <= end and \
+                    (best is None or cls.lineno > best.lineno):
+                best = cls
+        if best is not None:
+            out.setdefault(best, {})[attr] = lock
+    return out
+
+
+def check(files: List[FileInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in files:
+        if fi.tree is None:
+            continue
+        attr_decls, mod_decls = _decls(fi)
+        if not attr_decls and not mod_decls:
+            continue
+        by_class = _class_guard_maps(fi, attr_decls)
+
+        for cls, guard in by_class.items():
+            for node in cls.body:
+                if not isinstance(node, ast.FunctionDef) or \
+                        node.name == "__init__" or \
+                        _caller_exempt(fi, node):
+                    continue
+                scan = _Scan(fi, f"{cls.name}.{node.name}", guard, {},
+                             set())
+                scan.scan(node.body, set())
+                out.extend(scan.findings)
+
+        if mod_decls:
+            # outermost defs only — scan() recurses into nested defs
+            # itself, so walking every FunctionDef would double-report
+            tops = [n for n in ast.iter_child_nodes(fi.tree)
+                    if isinstance(n, ast.FunctionDef)]
+            for cls in (n for n in ast.walk(fi.tree)
+                        if isinstance(n, ast.ClassDef)):
+                tops.extend(n for n in cls.body
+                            if isinstance(n, ast.FunctionDef))
+            for node in tops:
+                if _caller_exempt(fi, node):
+                    continue
+                globals_decl: Set[str] = set()
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Global):
+                        globals_decl.update(n.names)
+                scan = _Scan(fi, node.name, {}, mod_decls, globals_decl)
+                scan.scan(node.body, set())
+                out.extend(scan.findings)
+    return out

@@ -1,0 +1,190 @@
+"""LGT006 — trace purity.
+
+Python inside a jitted program body runs ONCE, at trace time. A
+`time.time()`, `os.environ` read, `np.random` draw, or `print` there
+does not execute per call — its VALUE is baked into the cached trace
+(or its side effect fires once and silently never again). The builder's
+LGBT_KCAP handling is the canonical near-miss: an env read inside a
+program factory is only sound because the same read is mirrored into
+the trace signature, and it carries an inline suppression saying so.
+
+Roots — functions whose bodies are trace-time Python:
+
+* defs decorated `@jax.jit` / `@functools.partial(jax.jit, ...)`;
+* `f` in `x = jax.jit(f, ...)` when `f` resolves to a same-file def;
+* factory arguments of the program registries —
+  `compile_cache.program(key, factory)`, `self._program(key, factory,
+  ...)`, `self._cached_program(key, factory)`. Factories run host-side
+  at build time, but everything they compute is baked into the trace,
+  so they are in scope; lambdas are followed into the names they call.
+
+Reachability is same-file only: bare `name(...)` and `self.method(...)`
+calls, transitively, nested defs included. Cross-module reachability is
+out of scope (the registries' key discipline is the cross-module
+defense).
+
+Impurity: attribute access on a `time` alias, `os.environ` (or a
+from-imported `environ`), `np.random`, from-imported `time` members,
+and `print(...)`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileInfo, Finding
+from . import _common
+
+RULE = "LGT006"
+TITLE = "trace purity"
+
+_REGISTRY_TAILS = ("._program", "._cached_program", ".program")
+
+
+def _is_jit_chain(node: ast.AST) -> bool:
+    chain = _common.attr_chain(node) or ""
+    return chain == "jit" or chain.endswith(".jit")
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_chain(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            chain = _common.attr_chain(dec.func) or ""
+            if chain == "jit" or chain.endswith(".jit"):
+                return True
+            if chain.endswith("partial") and dec.args and \
+                    _is_jit_chain(dec.args[0]):
+                return True
+    return False
+
+
+def _called_names(node: ast.AST) -> Set[str]:
+    """Bare and self.* callee names inside `node`."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                out.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == "self":
+                out.add(n.func.attr)
+    return out
+
+
+class _FilePurity:
+    def __init__(self, fi: FileInfo) -> None:
+        self.fi = fi
+        tree = fi.tree
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, ast.FunctionDef):
+                self.defs.setdefault(n.name, []).append(n)
+        self.time_aliases = _common.import_aliases(tree, "time")
+        self.os_aliases = _common.import_aliases(tree, "os")
+        self.np_aliases = _common.import_aliases(tree, "numpy")
+        self.environ_names = _common.from_import_aliases(
+            tree, "os", "environ")
+        self.time_names: Set[str] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ImportFrom) and n.module == "time":
+                for a in n.names:
+                    self.time_names.add(a.asname or a.name)
+
+    # -- roots --------------------------------------------------------------
+    def roots(self) -> Dict[str, str]:
+        """root def name -> how it became a root."""
+        out: Dict[str, str] = {}
+        for name, fns in self.defs.items():
+            if any(_jit_decorated(fn) for fn in fns):
+                out.setdefault(name, "@jax.jit")
+        for n in ast.walk(self.fi.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            chain = _common.attr_chain(n.func) or ""
+            factory: Optional[ast.AST] = None
+            how = ""
+            if (chain == "jit" or chain.endswith(".jit")) and n.args:
+                factory, how = n.args[0], "jax.jit(...)"
+            elif len(n.args) >= 2 and (
+                    chain.endswith(_REGISTRY_TAILS) or
+                    chain == "program"):
+                factory, how = n.args[1], f"{chain}(...) factory"
+            if factory is None:
+                continue
+            if isinstance(factory, ast.Name) and \
+                    factory.id in self.defs:
+                out.setdefault(factory.id, how)
+            elif isinstance(factory, ast.Lambda):
+                for callee in _called_names(factory.body):
+                    if callee in self.defs:
+                        out.setdefault(callee, how + " (via lambda)")
+        return out
+
+    def reachable(self, root: str) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [root]
+        while frontier:
+            name = frontier.pop()
+            if name in seen or name not in self.defs:
+                continue
+            seen.add(name)
+            for fn in self.defs[name]:
+                for callee in _called_names(fn):
+                    if callee not in seen and callee in self.defs:
+                        frontier.append(callee)
+        return seen
+
+    # -- impurity -----------------------------------------------------------
+    def impurities(self, fn: ast.FunctionDef) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name):
+                base = n.value.id
+                if base in self.time_aliases:
+                    out.append((n.lineno, f"time.{n.attr}"))
+                elif base in self.os_aliases and n.attr == "environ":
+                    out.append((n.lineno, "os.environ"))
+                elif base in self.np_aliases and n.attr == "random":
+                    out.append((n.lineno, "np.random"))
+            elif isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load):
+                if n.id in self.environ_names:
+                    out.append((n.lineno, "os.environ"))
+                elif n.id in self.time_names:
+                    out.append((n.lineno, f"time.{n.id}"))
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Name) and \
+                    n.func.id == "print":
+                out.append((n.lineno, "print(...)"))
+        return out
+
+
+def check(files: List[FileInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in files:
+        if fi.tree is None:
+            continue
+        fp = _FilePurity(fi)
+        roots = fp.roots()
+        if not roots:
+            continue
+        seen_sites: Set[Tuple[int, str]] = set()
+        for root, how in sorted(roots.items()):
+            for name in sorted(fp.reachable(root)):
+                for fn in fp.defs[name]:
+                    for line, what in fp.impurities(fn):
+                        if (line, what) in seen_sites:
+                            continue
+                        seen_sites.add((line, what))
+                        via = "" if name == root else \
+                            f" (reached from {root})"
+                        out.append(Finding(
+                            RULE, fi.relpath, line,
+                            f"{what} inside {name}{via}, which is "
+                            f"traced via {how} — its value is baked "
+                            f"into the cached program"))
+    return out

@@ -55,6 +55,7 @@ def gen_data():
 
 def main():
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import trace as obs_trace
     X, y, group = gen_data()
     print(f"# data ready n={N} f={F} mb={MB} mode={MODE}", flush=True)
     params = {
@@ -83,14 +84,14 @@ def main():
         bst.update()
     eng = getattr(gb, "_aligned_eng_ref", None)
     if eng is not None:
-        jax.block_until_ready(eng.rec[0, 0, :1])
+        obs_trace.force_fence(eng.rec[0, 0, :1])
         print(f"# aligned engine: W={eng.W} w_used={eng.w_used} "
               f"ext={eng.ext} C={eng.C}", flush=True)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
     if eng is not None:
-        jax.block_until_ready(eng.rec[0, 0, :1])
+        obs_trace.force_fence(eng.rec[0, 0, :1])
     else:
         np.asarray(gb.train_score.score.reshape(-1)[:1])
     dt = (time.perf_counter() - t0) / ITERS

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
+from lightgbm_tpu.obs import trace as obs_trace
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
 MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
@@ -76,7 +77,7 @@ def main():
     for _ in range(4):
         gb.train_one_iter()
     eng = gb._aligned_eng_ref
-    jax.block_until_ready(eng.rec)
+    obs_trace.force_fence(eng.rec)
 
     # ---- per-iter window
     specs = []
@@ -84,7 +85,7 @@ def main():
     for _ in range(ITERS):
         gb.train_one_iter()
         specs.append(gb.models[-1].record)
-    jax.block_until_ready(eng.rec)
+    obs_trace.force_fence(eng.rec)
     dt = (time.perf_counter() - t0) / ITERS
     rounds = [int(jax.device_get(s.rounds)) for s in specs]
     nexec = [int(jax.device_get(s.n_exec)) for s in specs]
@@ -110,11 +111,11 @@ def main():
 
     def timeit(fn, reps=8):
         out = fn()
-        jax.block_until_ready(out)
+        obs_trace.force_fence(out)
         t0 = time.perf_counter()
         for _ in range(reps):
             out = fn()
-        jax.block_until_ready(out)
+        obs_trace.force_fence(out)
         return (time.perf_counter() - t0) / reps
 
     rec = eng.rec

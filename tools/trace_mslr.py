@@ -23,6 +23,7 @@ def main():
     import jax
     import time
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import trace as obs_trace
     from profile_mslr import gen_data
     X, y, group = gen_data()
     params = {
@@ -41,7 +42,7 @@ def main():
     def sync():
         eng = getattr(gb, "_aligned_eng_ref", None)
         if eng is not None:
-            jax.block_until_ready(eng.rec[0, 0, :1])
+            obs_trace.force_fence(eng.rec[0, 0, :1])
 
     for i in range(6):
         t0 = time.perf_counter()

@@ -23,6 +23,7 @@ LOG = "/tmp/jaxtrace_r4"
 
 def main():
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import trace as obs_trace
     z = np.load(CACHE)
     bins, label = z["bins"], z["label"]
     params = {"objective": "binary", "num_leaves": 255,
@@ -37,14 +38,14 @@ def main():
     for i in range(10):
         t0 = time.perf_counter()
         gb.train_one_iter()
-        jax.block_until_ready(gb._aligned_eng_ref.rec[0, 0, :1])
+        obs_trace.force_fence(gb._aligned_eng_ref.rec[0, 0, :1])
         print(f"warm iter {i}: {time.perf_counter()-t0:.3f}s", flush=True)
     os.system(f"rm -rf {LOG}")
     t0 = time.perf_counter()
     with jax.profiler.trace(LOG):
         for _ in range(NTRACE):
             gb.train_one_iter()
-        jax.block_until_ready(gb._aligned_eng_ref.rec[0, 0, :1])
+        obs_trace.force_fence(gb._aligned_eng_ref.rec[0, 0, :1])
     wall = time.perf_counter() - t0
     print(f"traced {NTRACE} iters wall={wall:.3f}s "
           f"({wall/NTRACE*1000:.1f} ms/iter)", flush=True)

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightgbm_tpu.obs import trace as obs_trace
+
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
 MB = int(sys.argv[2]) if len(sys.argv) > 2 else 63
 F = 28
@@ -22,11 +24,11 @@ S = 64     # slots for the bench (small store)
 
 def timeit(fn, reps=4):
     out = fn()
-    jax.block_until_ready(out)
+    obs_trace.force_fence(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn()
-        jax.block_until_ready(out)
+        obs_trace.force_fence(out)
     dt = (time.perf_counter() - t0) / reps
     leaf = jax.tree_util.tree_leaves(out)[0]
     chk = float(jnp.sum(leaf[:2].astype(jnp.float32)))
