@@ -620,6 +620,117 @@ def run_ref_parity(X, y, hX, hy, leaves):
     return auc_ours, auc_ref
 
 
+def multichip_child() -> None:
+    """BENCH_MULTICHIP_CHILD=1 mode: one point of the scaling curve in a
+    fresh process whose device topology was fixed by the parent's env
+    (XLA_FLAGS --xla_force_host_platform_device_count=N under CPU
+    emulation; the real device set otherwise). Trains tree_learner=data
+    on the FIXED global row count and emits one JSON line with the
+    per-iteration wall and the per-device HBM claims the accountant
+    attributes to the dist/ shard owners."""
+    import jax
+
+    n = int(os.environ["BENCH_MC_ROWS"])
+    f = int(os.environ.get("BENCH_FEATURES", 28))
+    iters = int(os.environ["BENCH_MC_ITERS"])
+    warmup = max(int(os.environ.get("BENCH_MC_WARMUP", 2)), 1)
+    leaves = int(os.environ.get("BENCH_LEAVES", 31))
+    ndev = int(os.environ["BENCH_MC_NDEV"])
+    X, y = synth_higgs(n, f)
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none",
+              # the byte-equal topology contract: f64 hist accumulation
+              # makes the model identical at every mesh width
+              "tpu_use_f64_hist": True,
+              "tree_learner": "data" if ndev > 1 else "serial",
+              "num_machines": ndev}
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+    bst = lgb.Booster(params=dict(params), train_set=ds)
+    g = bst._gbdt
+    from lightgbm_tpu.obs import trace as obs_trace
+    for _ in range(warmup):
+        bst.update()
+    obs_trace.force_fence(g.train_score.score)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    obs_trace.force_fence(g.train_score.score)
+    per_iter_ms = (time.perf_counter() - t0) / iters * 1e3
+    from lightgbm_tpu.obs import memory as obs_memory
+    owners = obs_memory.owners_bytes()
+    mb = 1 << 20
+    per_dev = {name.split("/")[-1]: round(info["bytes"] / mb, 2)
+               for name, info in sorted(owners.items())
+               if name.startswith("dist/shard_bytes/")}
+    if not per_dev:   # 1-device baseline: the whole binned matrix on d0
+        per_dev = {"d0": round(sum(
+            i["bytes"] for nm, i in owners.items()
+            if nm.startswith("dataset/bins")) / mb, 2)}
+    print(json.dumps({
+        "devices": ndev,
+        "visible_devices": len(jax.devices()),
+        "per_iter_ms": round(per_iter_ms, 2),
+        "hbm_claimed_mb": per_dev,
+    }), flush=True)
+
+
+def run_multichip(out):
+    """MULTICHIP scaling curve: fixed global rows re-trained at mesh
+    widths 1..N, each in a fresh child process so the device topology is
+    real (emulated via XLA host-platform device count on CPU, the actual
+    accelerator set otherwise) — speedup numbers never come from
+    re-slicing one process's devices."""
+    import subprocess
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n = int(os.environ.get("BENCH_MC_ROWS", 40_000 if smoke else 500_000))
+    iters = int(os.environ.get("BENCH_MC_ITERS", 4 if smoke else 15))
+    max_dev = int(os.environ.get("BENCH_MC_MAX_DEVICES",
+                                 4 if smoke else 8))
+    import jax
+    emulate = jax.default_backend() == "cpu"
+    if not emulate:
+        max_dev = min(max_dev, len(jax.devices()))
+    ns = [1]
+    while ns[-1] * 2 <= max_dev:
+        ns.append(ns[-1] * 2)
+    curve = []
+    for ndev in ns:
+        env = dict(os.environ)
+        env["BENCH_MULTICHIP_CHILD"] = "1"
+        env["BENCH_MC_ROWS"] = str(n)
+        env["BENCH_MC_ITERS"] = str(iters)
+        env["BENCH_MC_NDEV"] = str(ndev)
+        if emulate:
+            flags = [t for t in env.get("XLA_FLAGS", "").split()
+                     if "force_host_platform_device_count" not in t]
+            flags.append(f"--xla_force_host_platform_device_count={ndev}")
+            env["XLA_FLAGS"] = " ".join(flags)
+            env["JAX_PLATFORMS"] = "cpu"
+        t0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=1800)
+        if res.returncode != 0:
+            log(f"# multichip {ndev}dev FAILED rc={res.returncode}: "
+                f"{res.stderr.strip().splitlines()[-1:]}")
+            continue
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        curve.append(rec)
+        log(f"# multichip {ndev}dev: per_iter_ms={rec['per_iter_ms']} "
+            f"({time.perf_counter() - t0:.1f}s total)")
+    if not curve:
+        return {}
+    base = curve[0]["per_iter_ms"]
+    for rec in curve:
+        rec["speedup_vs_1dev"] = round(
+            base / max(rec["per_iter_ms"], 1e-9), 3)
+    return {"multichip": {"rows": n, "iters": iters,
+                          "tree_learner": "data",
+                          "emulated_cpu_devices": emulate,
+                          "curve": curve}}
+
+
 def warm_rerun_child() -> None:
     """BENCH_WARMRERUN_CHILD=1 mode: a fresh process repeating ONLY the
     63-bin bin+warmup leg on identical data, so the parent can certify
@@ -698,6 +809,9 @@ def run_warm_rerun(out):
 
 
 def main() -> None:
+    if os.environ.get("BENCH_MULTICHIP_CHILD") == "1":
+        multichip_child()
+        return
     if os.environ.get("BENCH_WARMRERUN_CHILD") == "1":
         warm_rerun_child()
         return
@@ -911,6 +1025,17 @@ def main() -> None:
         except Exception as e:   # the summary line must still print
             log(f"# resume stage FAILED: {type(e).__name__}: {e}")
         _stage_done("resume", out)
+
+    # ---- stage 5.7: MULTICHIP scaling curve (dist/ runtime): fixed
+    # global rows at mesh widths 1..N, one fresh child per width --------
+    if stage_gate(out, "multichip", "BENCH_SKIP_MULTICHIP",
+                  est_s=_GATE.wall("higgs63") * (0.5 if smoke else 1.2)):
+        _stage("multichip")
+        try:
+            out.update(run_multichip(out))
+        except Exception as e:   # the summary line must still print
+            log(f"# multichip stage FAILED: {type(e).__name__}: {e}")
+        _stage_done("multichip", out)
 
     # ---- stage 6: fresh-process warm rerun (certifies the persistent
     # cache: the child re-pays binning but should load, not compile) ----

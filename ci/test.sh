@@ -157,6 +157,70 @@ else
     rm -rf "$(dirname "$RES_DIR")"
 fi
 
+echo "== distributed smoke (8 emulated devices, tree_learner=data, byte-equal vs serial) =="
+DIST_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_dist"
+mkdir -p "$DIST_DIR"
+python - <<EOF
+import numpy as np
+rng = np.random.RandomState(23)
+X = rng.rand(4000, 12).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(4000) > 0.5).astype(np.float32)
+np.savetxt("$DIST_DIR/train.tsv",
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+# the shared leg of both runs; tpu_use_f64_hist pins histogram
+# accumulation to order-independent f64 — the byte-equal topology contract
+DIST_ARGS="task=train data=$DIST_DIR/train.tsv objective=binary
+           num_leaves=15 num_iterations=5 tpu_use_f64_hist=true"
+# serial reference on the plain 1-device backend
+# shellcheck disable=SC2086
+python -m lightgbm_tpu $DIST_ARGS verbosity=-1 tree_learner=serial \
+    output_model="$DIST_DIR/serial.txt" > "$DIST_DIR/serial.log" 2>&1
+# 4-shard data-parallel run on an 8-device virtual mesh; traced so the
+# ledger can be schema-validated, verbose so the dist_* events land in
+# the log (the event channel is INFO-level)
+# shellcheck disable=SC2086
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m lightgbm_tpu $DIST_ARGS verbosity=2 tree_learner=data \
+    num_machines=4 output_model="$DIST_DIR/dist.txt" tpu_trace=true \
+    tpu_trace_dir="$DIST_DIR/trace" > "$DIST_DIR/dist.log" 2>&1
+if ! cmp -s "$DIST_DIR/serial.txt" "$DIST_DIR/dist.txt"; then
+    echo "FAIL: 4-shard model is not byte-equal to the serial model" >&2
+    diff "$DIST_DIR/serial.txt" "$DIST_DIR/dist.txt" | head -20 >&2
+    exit 1
+fi
+DIST_SMOKE_DIR="$DIST_DIR" python - <<'EOF'
+import glob
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+from lightgbm_tpu.utils.log import parse_event
+
+d = os.environ["DIST_SMOKE_DIR"]
+paths = sorted(glob.glob(os.path.join(d, "trace", "ledger-*.jsonl")))
+assert paths, f"no ledger written under {d}/trace"
+recs = obs_ledger.read_ledger(paths[-1])
+for rec in recs:
+    obs_ledger.validate_record(rec)
+rounds = [r for r in recs if r["kind"] == "round"]
+assert [r["round"] for r in rounds] == list(range(5)), rounds
+# the dist runtime announced its topology on the event channel
+events = [e for e in (parse_event(ln.strip())
+                      for ln in open(os.path.join(d, "dist.log")))
+          if e]
+kinds = {e["event"] for e in events}
+assert {"dist_init", "dist_shard"} <= kinds, kinds
+init = next(e for e in events if e["event"] == "dist_init")
+assert init["shards"] == 4 and init["tree_learner"] == "data", init
+print(f"distributed smoke: ok (4-shard model byte-equal, "
+      f"{len(recs)} schema-valid ledger records, events={sorted(kinds)})")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "distributed artifacts kept under $DIST_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$DIST_DIR")"
+fi
+
 echo "== serving smoke (2 models, hot swap under threaded load) =="
 SERVE_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_serve"
 mkdir -p "$SERVE_DIR"

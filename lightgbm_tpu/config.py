@@ -346,7 +346,21 @@ class Config:
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
-    tpu_use_f64_hist: bool = False      # accumulate histograms in f64 (2x pass)
+    # accumulate histograms and root grad/hess sums in f64 (2x pass).
+    # f64 sums of f32 gradients are exact at any realistic leaf size, so
+    # the per-shard partials entering a cross-device all-reduce are
+    # order-independent: a distributed run produces byte-identical model
+    # text to a single-device run (the parity contract of the dist/
+    # runtime — see docs/Distributed.md). Off by default: the bf16x2
+    # MXU path is ~f32-accurate and faster on TPU
+    tpu_use_f64_hist: bool = False
+    # device count for the distributed runtime (dist/runtime.py): 0 =
+    # derive from num_machines (>1) or use every visible device when a
+    # non-serial tree_learner is selected; N > 0 = shard over exactly
+    # the first N devices. Runtime-only topology: does not change the
+    # trained model (see tpu_use_f64_hist) and is excluded from model
+    # text and checkpoint signatures
+    tpu_dist_devices: int = 0
     tpu_hist_chunk: int = 1 << 16        # rows per histogram matmul chunk
     # pallas VMEM-resident histogram kernel (ops/pallas_hist.py, the
     # ocl/histogram256.cl analogue): the one-hot tile never leaves VMEM,
@@ -673,6 +687,12 @@ class Config:
             pass
         if self.tree_learner != "serial":
             self.is_parallel = True
+            # distributed construction also finds bins through the
+            # global-sync path (dist/binning.py) — per-shard sample
+            # passes merged into boundaries identical on every shard
+            # (reference CheckParamConflict sets the same flag for
+            # parallel learners, config.cpp:232-238)
+            self.is_parallel_find_bin = True
             if self.num_machines <= 1:
                 # single machine: fall back to serial semantics but keep the
                 # learner (it degrades to a 1-shard mesh)

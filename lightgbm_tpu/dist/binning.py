@@ -1,0 +1,108 @@
+"""Distributed bin-boundary finding.
+
+Re-creates the reference's global bin-sync protocol
+(`DataParallelTreeLearner` setup + `DatasetLoader::CostructFromSampleData`
+with `Network::GlobalSyncUpByMin/Max` and the sampled-quantile allgather,
+`src/io/dataset_loader.cpp:535`, `src/network/network.cpp`): every worker
+samples ITS contiguous row block, the per-shard sample contributions are
+merged in block order, and the merged sample — bitwise-identical to what a
+single host would have drawn — feeds the exact same `BinMapper.find_bin`
+on every shard.
+
+The parity argument, which `tests/test_dist.py` asserts bitwise:
+
+- the sample INDEX set is drawn from one shared seed
+  (`cfg.data_random_seed`, the reference broadcasts its random seeds the
+  same way) and sorted, so every shard agrees on it without traffic;
+- shard ``s`` owns global rows ``[s*per, (s+1)*per)`` — the contiguous
+  block layout of `DataParallelTreeLearner` — and contributes exactly the
+  sampled rows inside its block;
+- concatenating the contributions in shard order re-creates the sorted
+  global sample verbatim, so the merged boundaries equal the single-host
+  boundaries bin for bin (no tolerance involved);
+- the mapper "broadcast" is emulated by a `to_dict`/`from_dict`
+  round-trip — the same wire format the binary dataset file uses — so a
+  serialization-lossy field would fail parity here, not on a real mesh.
+
+On a real multi-host mesh the concatenate becomes an allgather of
+variable-length per-shard slices; the merge order and everything after it
+are unchanged, which is the point: the sync protocol is host-side numpy
+either way, and the devices only ever see the finished bins.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..io.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+
+__all__ = [
+    "find_bin_mappers_distributed",
+    "merged_sample",
+    "shard_sample_indices",
+]
+
+
+def shard_sample_indices(n: int, sample_cnt: int, seed: int,
+                         num_shards: int) -> List[np.ndarray]:
+    """Per-shard GLOBAL sample indices: the single shared draw split by
+    contiguous row block. ``concatenate(result)`` is exactly the sorted
+    single-host sample index array."""
+    rng = np.random.RandomState(seed)
+    if sample_cnt < n:
+        idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+    else:
+        idx = np.arange(n, dtype=np.int64)
+    per = int(math.ceil(n / num_shards))
+    return [idx[(idx >= s * per) & (idx < (s + 1) * per)]
+            for s in range(num_shards)]
+
+
+def merged_sample(data: np.ndarray, sample_cnt: int, seed: int,
+                  num_shards: int) -> np.ndarray:
+    """The global sample matrix as the distributed protocol produces it:
+    per-shard contributions concatenated in block order."""
+    parts = shard_sample_indices(len(data), sample_cnt, seed, num_shards)
+    return np.concatenate([np.asarray(data[p]) for p in parts], axis=0)
+
+
+def find_bin_mappers_distributed(
+        data: np.ndarray, cfg, cat_set: Set[int],
+        num_shards: int) -> Tuple[List[BinMapper], Dict[str, float]]:
+    """Global-sync bin finding over `num_shards` contiguous row blocks.
+
+    Returns ``(mappers, stats)`` where `stats` carries the host wall time
+    of the whole sync (`bin_sync_ms`, the calibration term of the same
+    name in obs/terms.py) and the per-shard sample counts.
+    """
+    t0 = time.perf_counter()
+    n, f = data.shape
+    sample_cnt = min(n, max(cfg.bin_construct_sample_cnt, 1))
+    parts = shard_sample_indices(n, sample_cnt, cfg.data_random_seed,
+                                 num_shards)
+    # "allgather": block-ordered concatenation of each shard's sampled rows
+    sample = np.concatenate([np.asarray(data[p]) for p in parts], axis=0)
+    mappers: List[BinMapper] = []
+    for j in range(f):
+        col = np.asarray(sample[:, j], dtype=np.float64)
+        nonzero = col[~((col >= -1e-35) & (col <= 1e-35))]
+        m = BinMapper()
+        bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+        m.find_bin(nonzero, total_sample_cnt=len(col),
+                   max_bin=cfg.max_bin,
+                   min_data_in_bin=cfg.min_data_in_bin,
+                   min_split_data=cfg.min_data_in_leaf,
+                   bin_type=bt, use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing)
+        # broadcast emulation: the mapper every shard actually uses has
+        # been through the wire format once
+        mappers.append(BinMapper.from_dict(m.to_dict()))
+    stats = {
+        "bin_sync_ms": (time.perf_counter() - t0) * 1e3,
+        "shards": num_shards,
+        "sample_cnt_per_shard": [int(len(p)) for p in parts],
+    }
+    return mappers, stats

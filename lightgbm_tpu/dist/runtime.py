@@ -1,0 +1,107 @@
+"""Distributed runtime: mesh construction, learner selection, resume
+rescatter — the glue that makes ``tree_learner=data|feature|voting`` a
+first-class `engine.train` / ``task=train`` path instead of a
+hand-constructed object.
+
+Topology resolution (``num_shards``):
+
+1. ``tpu_dist_devices > 0`` pins the mesh to the first N visible devices
+   (the operator's explicit slice carve-out);
+2. else ``num_machines > 1`` — the reference's own topology knob — asks
+   for that many shards;
+3. else every visible device joins the mesh.
+
+Either way the request is clamped to the devices that exist, so a config
+written for a v5p-16 also runs under 8 emulated CPU devices, just
+narrower. All three params are runtime-only (model_text/checkpoint
+RUNTIME_ONLY_PARAMS), matching the reference: with ``tpu_use_f64_hist``
+the data-parallel model is bitwise-independent of topology, so the dump
+must be too.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["active", "build_mesh", "make_learner", "num_shards",
+           "rescatter_scores"]
+
+_PARALLEL_MODES = ("data", "feature", "voting")
+
+
+def num_shards(cfg) -> int:
+    """Mesh width the config asks for, clamped to visible devices."""
+    import jax
+    nd = len(jax.devices())
+    if int(getattr(cfg, "tpu_dist_devices", 0)) > 0:
+        return max(1, min(int(cfg.tpu_dist_devices), nd))
+    if int(cfg.num_machines) > 1:
+        return max(1, min(int(cfg.num_machines), nd))
+    return nd
+
+
+def active(cfg) -> bool:
+    """True when a parallel tree_learner should actually go SPMD (a
+    1-wide mesh degenerates to the serial device learner)."""
+    return cfg.tree_learner in _PARALLEL_MODES and num_shards(cfg) > 1
+
+
+def build_mesh(cfg, axis_name: str = "data"):
+    """1-D mesh over the first `num_shards(cfg)` devices."""
+    from ..parallel import default_mesh
+    return default_mesh(num_shards(cfg), axis_name)
+
+
+def make_learner(cfg, train_data):
+    """Factory entry for GBDT: build the mesh, shard the dataset onto it
+    (data/voting — feature-parallel replicates rows), construct the
+    learner, announce the topology on the event channel."""
+    from ..parallel import make_parallel_learner
+    from ..utils import log
+
+    axis = "feature" if cfg.tree_learner == "feature" else "data"
+    mesh = build_mesh(cfg, axis)
+    if cfg.tree_learner in ("data", "voting"):
+        train_data.shard(mesh, axis)      # cache-primed; learner reuses
+    learner = make_parallel_learner(cfg, train_data, mesh=mesh)
+    kinds = sorted({d.platform for d in mesh.devices.flat})
+    log.event("dist_init", tree_learner=cfg.tree_learner,
+              shards=int(mesh.devices.size), axis=axis,
+              device_kinds=",".join(kinds))
+    return learner
+
+
+def rescatter_scores(gbdt) -> bool:
+    """After a checkpoint restore placed the gathered ``[K, N]`` score
+    buffers as single-device arrays, push them back onto the learner's
+    mesh (rows sharded along the data axis) so the resumed round loop
+    runs SPMD without an implicit broadcast-and-reshard on its first
+    dispatch. Values are untouched — bitwise resume parity is carried by
+    the array contents, placement is performance. Returns True when a
+    rescatter happened."""
+    learner = getattr(gbdt, "learner", None)
+    mesh = getattr(learner, "mesh", None)
+    axis = getattr(learner, "axis_name", None)
+    if mesh is None or axis is None or axis != "data":
+        return False
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..utils import log
+
+    nd = int(mesh.devices.size)
+    moved = 0
+
+    def _place(arr):
+        nonlocal moved
+        n = int(arr.shape[-1])
+        spec = P(None, axis) if n % nd == 0 else P()
+        moved += 1
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    gbdt.train_score.score = _place(gbdt.train_score.score)
+    for su in gbdt.valid_scores:
+        # valid rows never leave their host-side metric path sharded;
+        # replicate them so eval programs see a mesh-committed buffer
+        su.score = jax.device_put(su.score, NamedSharding(mesh, P()))
+        moved += 1
+    log.event("dist_resume", shards=nd, buffers=moved)
+    return True

@@ -230,6 +230,16 @@ class Dataset:
             self.monotone_constraints = reference.monotone_constraints
             self.feature_penalty = reference.feature_penalty
             self.feature_names = reference.feature_names
+        elif getattr(cfg, "is_parallel_find_bin", False):
+            # --- distributed global-sync bin finding: per-shard sample
+            #     contributions merged in block order (dist/binning.py);
+            #     boundaries are bitwise-equal to the single-host path
+            from ..dist import runtime as dist_runtime
+            from ..dist.binning import find_bin_mappers_distributed
+            self.mappers, sync_stats = find_bin_mappers_distributed(
+                data, cfg, cat_set, dist_runtime.num_shards(cfg))
+            self._bin_sync_ms = float(sync_stats["bin_sync_ms"])
+            _finalize_used_features(self, cfg, f)
         else:
             # --- sample rows for bin finding (reference
             #     bin_construct_sample_cnt, dataset_loader.cpp:162+)
@@ -577,6 +587,64 @@ class Dataset:
             return    # not worth the indirection
         self.bundles = info
         self.bins = apply_bundles(self.bins, info, db)
+
+    # ------------------------------------------------------------------
+    def shard(self, mesh, axis_name: str = "data") -> Dict[str, Any]:
+        """Mesh-sharded HBM placement of the binned matrix: contiguous row
+        blocks per device via `NamedSharding` (the layout the data-parallel
+        learner assumes, parallel/data_parallel.py). The placement is
+        cached per mesh so the loader/CLI can shard EARLY and the learner
+        reuses the same device buffers instead of re-uploading.
+
+        Returns the cache dict: ``mesh``, ``axis_name``, ``nd``,
+        ``per_shard``, ``pad_rows``, row-sharded ``bins`` and its
+        column-sharded transpose ``bins_T``.
+        """
+        import math as _math
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (tuple(int(d.id) for d in mesh.devices.flat), axis_name)
+        cached = getattr(self, "_shard_cache", None)
+        if cached is not None and cached["key"] == key:
+            return cached
+        if self.bins is None:
+            raise ValueError("shard() needs a constructed dataset "
+                             "(bins is None)")
+        nd = int(mesh.devices.size)
+        n = self.num_data
+        per_shard = int(_math.ceil(n / nd))
+        pad_rows = nd * per_shard - n
+        bins_np = np.asarray(self.bins)
+        if pad_rows:
+            bins_np = np.pad(bins_np, ((0, pad_rows), (0, 0)))
+        bins_sharded = jax.device_put(
+            bins_np, NamedSharding(mesh, P(axis_name)))
+        # transposed copy, row-sharded along its second axis, for the
+        # contiguous split-column reads inside the tree build
+        bins_t = jax.device_put(
+            np.ascontiguousarray(bins_np.T),
+            NamedSharding(mesh, P(None, axis_name)))
+        cache = {"key": key, "mesh": mesh, "axis_name": axis_name,
+                 "nd": nd, "per_shard": per_shard, "pad_rows": pad_rows,
+                 "bins": bins_sharded, "bins_T": bins_t}
+        self._shard_cache = cache
+        # per-device HBM owners: each device holds per_shard rows of the
+        # binned matrix plus its slice of the transpose
+        from ..obs import memory as obs_memory
+        per_dev = 2 * per_shard * int(bins_np.shape[1]) * bins_np.itemsize
+        for i in range(nd):
+            obs_memory.track(
+                f"dist/shard_bytes/d{i}", self,
+                lambda d, nb=per_dev, k=key: (
+                    nb if (getattr(d, "_shard_cache", None) is not None
+                           and d._shard_cache["key"] == k) else 0))
+        from ..utils import log
+        log.event("dist_shard", shards=nd, rows_per_shard=per_shard,
+                  pad_rows=pad_rows, bytes_per_device=per_dev,
+                  bin_sync_ms=getattr(self, "_bin_sync_ms", None))
+        return cache
 
     def _native_bin_matrix(self, data: np.ndarray, used: np.ndarray,
                            dtype) -> Optional[np.ndarray]:

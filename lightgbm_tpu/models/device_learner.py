@@ -241,7 +241,11 @@ class DeviceTreeLearner:
         self.finder = make_split_finder(self.hyper, meta, self.max_bin_global)
         self.mappers = dataset.used_mappers()
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
-        if cfg.gpu_use_dp or cfg.tpu_use_f64_hist:
+        if cfg.tpu_use_f64_hist:
+            # genuine f64 accumulation (ops/histogram.py): exact, hence
+            # topology-invariant — required for byte-equal distributed parity
+            self.hist_precision = "f64"
+        elif cfg.gpu_use_dp:
             self.hist_precision = "f32"
         elif cfg.tpu_use_pallas:
             from ..ops.pallas_hist import pallas_available
@@ -608,6 +612,11 @@ class DeviceTreeLearner:
                                      (size, f_block))
             hb = histogram_from_gathered_gh(rows, gh, valid, BH, chunk,
                                             precision)
+            if hb.dtype == jnp.float64:
+                with jax.experimental.enable_x64():
+                    full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float64)
+                    return lax.dynamic_update_slice(
+                        full, hb, (start, jnp.int32(0), jnp.int32(0)))
             full = jnp.zeros((F, B, NUM_HIST_STATS), jnp.float32)
             return lax.dynamic_update_slice(
                 full, hb, (start, jnp.int32(0), jnp.int32(0)))
@@ -648,14 +657,23 @@ class DeviceTreeLearner:
         #            scalars are already global
         #   voting:  histograms stay LOCAL (only elected features are
         #            reduced, inside eval_leaf); row-local scalars psum'd
+        # Under precision == "f64" the partials entering a collective are
+        # exact, so psum(partials) == serial total in f64; the single
+        # f64→f32 rounding AFTER the reduce makes every downstream value
+        # bit-identical across topologies (the byte-equal parity contract
+        # of dist/runtime.py).
         def _gsum_hist(x):
             if axis is not None and mode in ("data", "feature"):
-                return lax.psum(x, axis)
+                x = lax.psum(x, axis)
+            if x.dtype == jnp.float64:
+                x = x.astype(jnp.float32)
             return x
 
         def _gsum_scalar(x):
             if axis is not None and mode in ("data", "voting"):
-                return lax.psum(x, axis)
+                x = lax.psum(x, axis)
+            if x.dtype == jnp.float64:
+                x = x.astype(jnp.float32)
             return x
 
         # loop budget: num_leaves-1 splits (0 when num_leaves == 1); Lm1 is
@@ -767,15 +785,24 @@ class DeviceTreeLearner:
                 rows = lax.slice(bins, (0, 0), (rp, bins.shape[1]))
                 gh0 = lax.slice(gh, (0, 0), (rp, 2))
                 root_hist = _feature_block_hist(rows, gh0, valid)
-                sums = jnp.sum(jnp.where(valid[:, None], gh0, 0.0), axis=0)
-                root_g, root_h = sums[0], sums[1]
+                masked = jnp.where(valid[:, None], gh0, 0.0)
+                if precision == "f64":
+                    # exact root sums: the partials entering the root-sums
+                    # allreduce must be order-independent (see _gsum_scalar)
+                    with jax.experimental.enable_x64():
+                        sums = jnp.sum(masked.astype(jnp.float64), axis=0)
+                        root_g, root_h = sums[0], sums[1]
+                else:
+                    sums = jnp.sum(masked, axis=0)
+                    root_g, root_h = sums[0], sums[1]
             else:
                 bsel = self._bucket_index(root_count, buckets)
                 root_hist = lax.switch(
                     bsel, hist_fns, bins, indices, gh, jnp.int32(0),
                     root_count)
                 root_g, root_h = _masked_sums(indices, gh, root_count,
-                                              root_padded)
+                                              root_padded,
+                                              f64=precision == "f64")
             root_hist = _gsum_hist(root_hist)
             # root grad/hess sums (data-parallel: the root-sums allreduce,
             # data_parallel_tree_learner.cpp:120-145)
@@ -1502,14 +1529,19 @@ def _partition_score_update(score, class_id, leaf_begin, leaf_cnt,
     return score.at[class_id].add(scale * delta)
 
 
-@functools.partial(jax.jit, static_argnames=("padded",))
-def _masked_sums(indices, gh, count, padded: int):
+@functools.partial(jax.jit, static_argnames=("padded", "f64"))
+def _masked_sums(indices, gh, count, padded: int, f64: bool = False):
     compile_cache.note_trace()
     idx = lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
     pos = jnp.arange(padded, dtype=jnp.int32)
     valid = pos < count
     safe = jnp.where(valid, idx, 0)
-    s = jnp.sum(jnp.where(valid[:, None], gh[safe], 0.0), axis=0)
+    masked = jnp.where(valid[:, None], gh[safe], 0.0)
+    if f64:
+        with jax.experimental.enable_x64():
+            s = jnp.sum(masked.astype(jnp.float64), axis=0)
+            return s[0], s[1]
+    s = jnp.sum(masked, axis=0)
     return s[0], s[1]
 
 

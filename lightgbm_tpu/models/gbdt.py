@@ -167,19 +167,16 @@ class GBDT:
             and not seq_host
             and cfg.tree_learner in ("serial", "data", "feature", "voting"))
         if self.use_fused:
-            if cfg.tree_learner == "serial" or len(jax.devices()) == 1:
+            # the dist runtime owns topology: it resolves the shard
+            # count (tpu_dist_devices / num_machines / all devices),
+            # builds the mesh, pre-shards the dataset onto it, and
+            # routes through parallel.make_parallel_learner. A 1-wide
+            # mesh degenerates to the serial device learner.
+            from ..dist import runtime as dist_runtime
+            if cfg.tree_learner == "serial" or not dist_runtime.active(cfg):
                 self.learner = DeviceTreeLearner(cfg, train_data)
-            elif cfg.tree_learner == "feature":
-                from ..parallel.feature_parallel import \
-                    FeatureParallelTreeLearner
-                self.learner = FeatureParallelTreeLearner(cfg, train_data)
-            elif cfg.tree_learner == "voting":
-                from ..parallel.voting_parallel import \
-                    VotingParallelTreeLearner
-                self.learner = VotingParallelTreeLearner(cfg, train_data)
             else:
-                from ..parallel.data_parallel import DataParallelTreeLearner
-                self.learner = DataParallelTreeLearner(cfg, train_data)
+                self.learner = dist_runtime.make_learner(cfg, train_data)
             self._trav_nb = jnp.asarray(self.learner.meta["num_bin"],
                                         jnp.int32)
             self._trav_db = jnp.asarray(self.learner.meta["default_bin"],
@@ -427,6 +424,37 @@ class GBDT:
             return pend_mc[0]
         return self.train_score.score
 
+    def _dist_allreduce_probe(self) -> None:
+        """Standalone histogram-shaped all-reduce through the fenced
+        dispatch seam, run ONLY inside a profiler-sampled round on a
+        mesh-parallel learner. The in-round psums are fused into the
+        whole-tree build program, so their cost hides inside the "build"
+        term; this probe times one histogram-sized `lax.psum` in
+        isolation, giving the ledger a per-round collective floor
+        (terms_ms["allreduce"], obs/terms.py) without touching the
+        training programs."""
+        if self._prof_round is None:
+            return
+        mesh = getattr(self.learner, "mesh", None)
+        ax = getattr(self.learner, "axis_name", None)
+        if mesh is None or ax is None or int(mesh.devices.size) < 2:
+            return
+        fn = getattr(self, "_allreduce_probe_fn", None)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..dist import shard_map as dist_shard_map
+            from ..ops.histogram import NUM_HIST_STATS
+            f = max(int(len(self.learner.meta["num_bin"])), 1)
+            b = max(int(self.cfg.max_bin), 2)
+            x = jnp.ones((f, b, NUM_HIST_STATS), jnp.float32)
+            mapped = dist_shard_map(lambda h: jax.lax.psum(h, ax),
+                                    mesh=mesh, in_specs=P(), out_specs=P())
+            jfn = jax.jit(mapped)
+            fn = lambda: jfn(x)            # noqa: E731 — tiny closure
+            self._allreduce_probe_fn = fn
+        self._dispatch_device("dist.allreduce", fn)
+
     def _train_one_iter_traced(self, grad, hess) -> bool:
         """One traced round: StepTraceAnnotation + span around the
         untouched implementation, ONE fence to split wall time into the
@@ -498,6 +526,9 @@ class GBDT:
             with obs_trace.step(rnd):
                 with obs_trace.span("train.round.profiled", round=rnd):
                     finished = self._train_one_iter_impl(grad, hess)
+                    # per-round collective visibility on parallel
+                    # learners (terms_ms["allreduce"]); no-op off-mesh
+                    self._dist_allreduce_probe()
                     # residual drain: device work not covered by a
                     # fenced site (host-applied trees, lazy syncs)
                     sample.timed("round_tail", self._round_fence_target)

@@ -144,7 +144,11 @@ def make_level_build_fn(learner):
 
     def _gsum(x):
         if axis is not None and mode == "data":
-            return lax.psum(x, axis)
+            x = lax.psum(x, axis)
+        if x.dtype == jnp.float64:
+            # single f64→f32 rounding after the reduce (same seam as the
+            # leaf-wise builder's _gsum_hist) — topology-invariant values
+            x = x.astype(jnp.float32)
         return x
 
     def _hist_slice(words, gw, hw, begin, padded: int, count):
@@ -199,8 +203,13 @@ def make_level_build_fn(learner):
         # ---------- root ----------
         root_hist = _gsum(histogram_from_words(words0, gw, hw, live, F, B,
                                                chunk, precision))
-        root_g = _gsum(jnp.sum(gw))
-        root_h = _gsum(jnp.sum(hw))
+        if precision == "f64":
+            with jax.experimental.enable_x64():
+                root_g = _gsum(jnp.sum(gw.astype(jnp.float64)))
+                root_h = _gsum(jnp.sum(hw.astype(jnp.float64)))
+        else:
+            root_g = _gsum(jnp.sum(gw))
+            root_h = _gsum(jnp.sum(hw))
         root_cnt_g = _gsum(local_cnt)
 
         # slot S and exec row Sm1 are DUMP targets: scatters from

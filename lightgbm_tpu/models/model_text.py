@@ -16,19 +16,29 @@ import numpy as np
 from ..config import Config
 from .tree import Tree
 
-# resilience-runtime knobs stay out of the serialized parameter dump: a
-# checkpointed/fault-injected run must produce byte-identical model text
-# to a plain run of the same training config (the bitwise-resume tests
-# diff whole model strings). Pre-existing runtime params keep dumping so
-# existing golden model files stay stable.
+# runtime knobs stay out of the serialized parameter dump: a
+# checkpointed / fault-injected / traced run must produce byte-identical
+# model text to a plain run of the same training config (the
+# bitwise-resume tests diff whole model strings), and so must runs that
+# differ only in output paths or verbosity. Topology params
+# (tree_learner, num_machines, ...) are runtime-only too: under
+# tpu_use_f64_hist the trees are bit-identical across topologies, so the
+# model text must be as well (the distributed byte-equal parity
+# contract, docs/Distributed.md). Must stay a SUBSET of
+# resilience/checkpoint.py RUNTIME_ONLY_PARAMS (graftlint LGT001).
 _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
     "tpu_fault_spec", "tpu_retry_max", "tpu_retry_backoff_s",
+    "tpu_trace", "tpu_trace_dir", "tpu_compile_cache_dir",
+    "snapshot_freq", "output_model", "input_model", "output_result",
+    "num_threads", "verbosity",
     "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
     "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
     "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
-    "tpu_profile_capture", "tpu_debug_locks"})
+    "tpu_profile_capture", "tpu_debug_locks",
+    "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
+    "tpu_dist_devices"})
 
 
 def _feature_infos(mappers) -> List[str]:

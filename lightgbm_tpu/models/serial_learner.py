@@ -78,7 +78,8 @@ class SerialTreeLearner:
         # padded dynamic_slice never wraps (see ops/partition.py)
         self.n_pad = self.n + _pow2_pad(self.n, cfg.tpu_min_pad)
         self.indices = init_partition(self.n, self.n_pad)
-        self.hist_precision = ("f32" if cfg.gpu_use_dp or cfg.tpu_use_f64_hist
+        self.hist_precision = ("f64" if cfg.tpu_use_f64_hist
+                               else "f32" if cfg.gpu_use_dp
                                else "bf16x2")
         self._monotone_any = bool(np.any(meta["monotone"] != 0))
         # CEGB state (serial_tree_learner.cpp:110-115,537-568): coupled
@@ -111,11 +112,15 @@ class SerialTreeLearner:
 
     def _leaf_hist(self, leaf: _LeafInfo, grad, hess):
         padded = _pow2_pad(leaf.count, self.cfg.tpu_min_pad)
-        return leaf_histogram(
+        hist = leaf_histogram(
             self.bins_dev, self.indices, jnp.int32(leaf.begin),
             jnp.int32(leaf.count), grad, hess, padded=padded,
             max_bin=self.max_bin_global, chunk=self.cfg.tpu_hist_chunk,
             precision=self.hist_precision)
+        if hist.dtype == jnp.float64:
+            # round once, matching the fused path's post-collective seam
+            hist = hist.astype(jnp.float32)
+        return hist
 
     def _find_best(self, leaf: _LeafInfo, feature_mask) -> dict:
         out = self.finder(leaf.hist, jnp.float32(leaf.sum_g),

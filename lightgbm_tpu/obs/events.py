@@ -50,6 +50,13 @@ EVENTS: Dict[str, str] = {
                              "model version (retried next tick)",
     "serve_watch_error": "checkpoint watcher poll raised; the thread "
                          "survives and retries",
+    # distributed runtime (dist/)
+    "dist_init": "distributed runtime activated: tree_learner mode, mesh "
+                 "shard count, device kinds",
+    "dist_resume": "resumed distributed run rescattered the gathered "
+                   "score buffers back onto the mesh",
+    "dist_shard": "dataset sharded across the mesh: rows per shard, "
+                  "per-device HBM bytes, bin-sync wall time",
     # resilience
     "checkpoint": "full-training-state checkpoint written (iter, path, "
                   "reason, write cost)",

@@ -36,8 +36,14 @@ TERMS: Dict[str, str] = {
     "eval": "device metric programs queued for per-round evaluation",
     "collective": "cross-device psum/all-reduce time on parallel "
                   "learners",
+    "allreduce": "standalone histogram-shaped all-reduce probe on a "
+                 "sampled round (per-round collective visibility for "
+                 "the distributed runtime)",
     "other": "residual device drain not attributed to a fenced site",
     # calibration (per-pass kernel rates)
+    "bin_sync": "host wall time of distributed bin-boundary finding "
+                "(per-shard sample pass + global merge) at dataset "
+                "construction",
     "hist": "slot histogram accumulation over the full record store",
     "route": "partition/routing move pass (decode + compact store), "
              "no hist slots",
@@ -61,6 +67,7 @@ SITE_TERMS: Dict[str, str] = {
     "learner.train_iter_fused": "build",
     "score_update": "score_update",
     "eval": "eval",
+    "dist.allreduce": "allreduce",
     "round_tail": "other",
 }
 

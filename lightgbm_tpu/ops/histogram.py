@@ -35,6 +35,17 @@ def _chunk_histogram(bins_chunk: jax.Array, payload: jax.Array,
     """
     iota = lax.broadcasted_iota(jnp.int32, (1, 1, max_bin), 2)
     onehot = (bins_chunk[:, :, None] == iota)  # [K, F, B] bool
+    if precision == "f64":
+        # Exact accumulation: f64 sums of f32 payloads are order-independent
+        # at any realistic leaf size (24-bit mantissa + log2(n) << 53 bits),
+        # so psum-of-shard-partials == serial total bit-for-bit. This is the
+        # topology-invariance anchor of the distributed runtime (the
+        # reference's hist_t is double for the same reason).
+        with jax.experimental.enable_x64():
+            oh = onehot.astype(jnp.float64)
+            return jnp.einsum("kfb,kw->fbw", oh,
+                              payload.astype(jnp.float64),
+                              precision=lax.Precision.HIGHEST)
     if precision == "f32":
         oh = onehot.astype(jnp.float32)
         return jnp.einsum("kfb,kw->fbw", oh, payload,
@@ -106,6 +117,13 @@ def histogram_from_gathered_gh(bins_rows: jax.Array, gh: jax.Array,
         b, w = xs
         return acc + _chunk_histogram(b, w, max_bin, precision), None
 
+    if precision == "f64":
+        # the scan carry must be f64 too — a f32 carry would round every
+        # chunk boundary and break the order-independence argument above
+        with jax.experimental.enable_x64():
+            init = jnp.zeros((f, max_bin, NUM_HIST_STATS), dtype=jnp.float64)
+            acc, _ = lax.scan(body, init, (bins_c, pay_c))
+        return acc
     init = jnp.zeros((f, max_bin, NUM_HIST_STATS), dtype=jnp.float32)
     acc, _ = lax.scan(body, init, (bins_c, pay_c))
     return acc

@@ -106,15 +106,25 @@ def leaf_value_fill(leaf_begin: jax.Array, leaf_count: jax.Array,
                     leaf_value: jax.Array, n_pad: int) -> jax.Array:
     """Per-POSITION leaf values from the final partition: leaves are disjoint
     contiguous [begin, begin+count) segments, so a difference array with
-    +value at each begin and -value at each end, cumsum'd, yields the value
-    of the covering leaf at every position — L tiny scatters + one prefix
-    sum instead of a per-row tree traversal.
+    +(id+1) at each begin and -(id+1) at each end, cumsum'd, yields the id
+    of the covering leaf at every position — L tiny scatters + one integer
+    prefix sum + one gather instead of a per-row tree traversal.
+
+    The cover ids are INTEGER so the fill is exact: a float ±value cumsum
+    telescopes rounding noise that depends on where the segment sits in the
+    partition, which breaks bitwise score parity between the global (serial)
+    and per-shard (data-parallel) partition layouts of the same tree.
     """
-    v = jnp.where(leaf_count > 0, leaf_value, 0.0)
-    d = jnp.zeros(n_pad + 1, jnp.float32)
-    d = d.at[jnp.where(leaf_count > 0, leaf_begin, n_pad)].add(v)
-    d = d.at[jnp.where(leaf_count > 0, leaf_begin + leaf_count, n_pad)].add(-v)
-    return jnp.cumsum(d[:-1])
+    live = leaf_count > 0
+    ids = jnp.arange(leaf_value.shape[0], dtype=jnp.int32) + 1
+    d = jnp.zeros(n_pad + 1, jnp.int32)
+    d = d.at[jnp.where(live, leaf_begin, n_pad)].add(jnp.where(live, ids, 0))
+    d = d.at[jnp.where(live, leaf_begin + leaf_count, n_pad)].add(
+        jnp.where(live, -ids, 0))
+    cover = jnp.cumsum(d[:-1])  # 0 outside every leaf, id+1 inside leaf id
+    vpad = jnp.concatenate(
+        [jnp.zeros((1,), leaf_value.dtype), leaf_value])
+    return vpad[cover]
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

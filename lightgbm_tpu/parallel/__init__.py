@@ -1,5 +1,41 @@
-"""Distributed tree learning over `jax.sharding.Mesh` — the XLA-collective
-replacement for the reference's `src/network/` + parallel tree learners."""
-from .data_parallel import DataParallelTreeLearner, default_mesh
+"""Parallel tree learners over a `jax.sharding.Mesh` — the XLA-collective
+replacement for the reference's `src/network/` + parallel tree learners.
 
-__all__ = ["DataParallelTreeLearner", "default_mesh"]
+`make_parallel_learner` is the factory axis the distributed runtime
+(`dist/runtime.py`) calls — the analogue of
+`TreeLearner::CreateTreeLearner` (tree_learner.cpp:13-36).
+"""
+from __future__ import annotations
+
+from .data_parallel import DataParallelTreeLearner, default_mesh
+from .feature_parallel import FeatureParallelTreeLearner
+from .voting_parallel import VotingParallelTreeLearner
+
+__all__ = [
+    "DataParallelTreeLearner",
+    "FeatureParallelTreeLearner",
+    "VotingParallelTreeLearner",
+    "default_mesh",
+    "make_parallel_learner",
+]
+
+_LEARNERS = {
+    "data": DataParallelTreeLearner,
+    "feature": FeatureParallelTreeLearner,
+    "voting": VotingParallelTreeLearner,
+}
+
+
+def make_parallel_learner(cfg, dataset, mesh=None):
+    """Construct the parallel learner selected by ``cfg.tree_learner``.
+
+    mesh: optional pre-built `jax.sharding.Mesh`; each learner builds its
+    own default mesh over the visible devices when omitted.
+    """
+    try:
+        cls = _LEARNERS[cfg.tree_learner]
+    except KeyError:
+        raise ValueError(
+            f"tree_learner={cfg.tree_learner!r} has no parallel learner "
+            f"(expected one of {sorted(_LEARNERS)})") from None
+    return cls(cfg, dataset, mesh=mesh)

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
+from ..dist import shard_map as dist_shard_map
 from ..io.dataset import Dataset
 from ..models.device_learner import DeviceTreeLearner, TreeRecord, _pow2ceil
 
@@ -70,17 +71,12 @@ class DataParallelTreeLearner:
         self.local_idx_len = self.per_shard + self.local_pad
         self.pad_rows = self.nd * self.per_shard - n
 
-        bins_np = np.asarray(dataset.bins)
-        if self.pad_rows:
-            bins_np = np.pad(bins_np, ((0, self.pad_rows), (0, 0)))
-        shard = NamedSharding(self.mesh, P(self.axis_name))
-        self.bins_sharded = jax.device_put(bins_np, shard)
-        # transposed copy, row-sharded along its second axis, for the
-        # contiguous split-column reads inside the tree build
-        self.bins_T_sharded = jax.device_put(
-            np.ascontiguousarray(bins_np.T),
-            NamedSharding(self.mesh, P(None, self.axis_name)))
-        self._row_shard = shard
+        # sharded placement comes from the Dataset-level cache so an
+        # early loader/CLI shard() and the learner share device buffers
+        placed = dataset.shard(self.mesh, self.axis_name)
+        self.bins_sharded = placed["bins"]
+        self.bins_T_sharded = placed["bins_T"]
+        self._row_shard = NamedSharding(self.mesh, P(self.axis_name))
         self._fn_cache = {}
 
     # --- delegation: GBDT uses these off the learner ------------------
@@ -147,7 +143,7 @@ class DataParallelTreeLearner:
             leaf_begin=P(ax), leaf_cnt_part=P(ax))
 
         if root_contiguous:
-            mapped = jax.shard_map(
+            mapped = dist_shard_map(
                 build, mesh=self.mesh,
                 in_specs=(P(ax), P(None, ax), P(ax), P(ax), P()),
                 out_specs=(P(ax), rec_specs),
@@ -167,7 +163,7 @@ class DataParallelTreeLearner:
         def per_shard(bins, bins_T, indices, grad, hess, counts, fmask):
             return build(bins, bins_T, indices, grad, hess, counts[0], fmask)
 
-        mapped = jax.shard_map(
+        mapped = dist_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(P(ax), P(None, ax), P(ax), P(ax), P(ax), P(ax), P()),
             out_specs=(P(ax), rec_specs),
@@ -196,7 +192,7 @@ class DataParallelTreeLearner:
             leaves = traverse_record(bins, trav, nb, db, mt)
             return score + scale * trav["leaf_value"][leaves]
 
-        mapped = jax.shard_map(
+        mapped = dist_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(P(ax), P(ax), P(), P(), P(), P(), P()),
             out_specs=P(ax), check_vma=False)
@@ -237,7 +233,7 @@ class DataParallelTreeLearner:
             delta = unpermute_to_rows(indices[:per], fill, cnt, per)
             return score + scale * delta
 
-        mapped = jax.shard_map(
+        mapped = dist_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(P(ax), P(ax), P(ax), P(), P(ax), P()),
             out_specs=P(ax), check_vma=False)
@@ -334,7 +330,7 @@ class DataParallelTreeLearner:
             rid=P(ax), n_exec=P(), execF=P(), execI=P(), execB=P(),
             bestF=P(), bestI=P(), bestB=P(), leafF=P(), leafI=P(ax),
             block_begin=P(ax), block_cnt=P(ax))
-        mapped = jax.shard_map(
+        mapped = dist_shard_map(
             build, mesh=self.mesh,
             in_specs=(P(None, ax), P(ax), P(ax), P()),
             out_specs=spec_specs,

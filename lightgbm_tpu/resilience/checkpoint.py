@@ -56,6 +56,12 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
     "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
+    # topology: trees are bit-identical across tree_learner / shard-count
+    # choices (distributed parity contract), so a checkpoint taken on one
+    # topology may resume on another — e.g. a preempted 4-chip run
+    # finishing on a single chip
+    "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
+    "tpu_dist_devices",
 })
 
 

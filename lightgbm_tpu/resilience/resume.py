@@ -109,6 +109,11 @@ def restore(booster, bundle: Dict[str, Any], callbacks=()) -> int:
                         "its scores will rebuild from the loaded trees")
             continue
         su.score = jnp.asarray(arrays[key])
+    # distributed runs: push the gathered score buffers back onto the
+    # learner's mesh so the resumed loop is SPMD from its first dispatch
+    # (values untouched — bitwise parity rides the contents)
+    from ..dist.runtime import rescatter_scores
+    rescatter_scores(gbdt)
 
     bag_idx = arrays.get("bag_data_indices")
     if bag_idx is not None and bag_idx.size:

@@ -90,6 +90,19 @@ def test_resume_bitwise_multiclass(tmp_path):
     assert res.model_to_string() == ref.model_to_string()
 
 
+def test_resume_bitwise_distributed(tmp_path):
+    """kill@R/resume parity for a 4-shard tree_learner=data run under the
+    8-device virtual mesh: the restore path gathers the sharded score
+    buffers from arrays.npz and the dist runtime rescatters them onto the
+    mesh, so the resumed run serializes to the uninterrupted run's bytes."""
+    params = dict(BAG, tree_learner="data", num_machines=4,
+                  tpu_use_f64_hist=True)
+    ref, part, res = _kill_resume_roundtrip(tmp_path, params, rounds=14,
+                                            kill_at=7)
+    assert part.num_trees() == 8
+    assert res.model_to_string() == ref.model_to_string()
+
+
 def test_resume_early_stopping_parity(tmp_path):
     X, y = _data()
     Xv, yv = _data(seed=7)
