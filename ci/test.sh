@@ -624,6 +624,104 @@ else
     rm -rf "$(dirname "$PROF_DIR")"
 fi
 
+echo "== streaming ingest smoke (chunked CLI load byte-equal + quantized hist) =="
+ING_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_ingest"
+mkdir -p "$ING_DIR"
+python - <<EOF
+import numpy as np
+rng = np.random.RandomState(31)
+X = rng.rand(5000, 10).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(5000) > 0.5).astype(np.float32)
+np.savetxt("$ING_DIR/train.tsv",
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+ING_ARGS="task=train data=$ING_DIR/train.tsv objective=binary
+          num_leaves=15 num_iterations=5"
+# classic in-memory load
+# shellcheck disable=SC2086
+python -m lightgbm_tpu $ING_ARGS verbosity=-1 \
+    output_model="$ING_DIR/mem.txt" > "$ING_DIR/mem.log" 2>&1
+# streamed load: chunk well under the 5000 rows, so the file goes
+# through count/sample/bin passes in 9 chunks; verbose so the
+# stream_ingest event and the CLI's ingest summary land in the log
+# shellcheck disable=SC2086
+python -m lightgbm_tpu $ING_ARGS verbosity=2 tpu_stream_chunk_rows=600 \
+    output_model="$ING_DIR/stream.txt" > "$ING_DIR/stream.log" 2>&1
+if ! cmp -s "$ING_DIR/mem.txt" "$ING_DIR/stream.txt"; then
+    echo "FAIL: streamed model is not byte-equal to the in-memory model" >&2
+    diff "$ING_DIR/mem.txt" "$ING_DIR/stream.txt" | head -20 >&2
+    exit 1
+fi
+grep -q '^Streamed ingest:' "$ING_DIR/stream.log" || {
+    echo "FAIL: CLI did not print the streamed-ingest summary" >&2
+    exit 1
+}
+ING_SMOKE_DIR="$ING_DIR" python - <<'EOF'
+import os
+
+from lightgbm_tpu.utils.log import parse_event
+
+d = os.environ["ING_SMOKE_DIR"]
+events = [e for e in (parse_event(ln.strip())
+                      for ln in open(os.path.join(d, "stream.log")))
+          if e]
+ing = [e for e in events if e["event"] == "stream_ingest"]
+assert ing, {e["event"] for e in events}
+assert ing[0]["rows"] == 5000 and ing[0]["chunk_rows"] == 600, ing[0]
+print(f"streaming ingest smoke: ok (5000 rows in chunks of 600, "
+      f"{ing[0]['device_cols']} device-binned cols, model byte-equal)")
+EOF
+# quantized-histogram leg: 5 rounds with int16 gradient quantization
+# must emit the quant_hist event and stay within AUC tolerance of f32
+python - <<'EOF'
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import log
+from lightgbm_tpu.utils.log import parse_event
+
+rng = np.random.RandomState(37)
+X = rng.rand(3000, 10)
+y = (X[:, 0] + 0.3 * rng.randn(3000) > 0.5).astype(float)
+
+
+def auc(labels, preds):
+    order = np.argsort(preds, kind="mergesort")
+    ranks = np.empty(len(preds))
+    ranks[order] = np.arange(1, len(preds) + 1)
+    pos = labels > 0
+    np_, nn = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - np_ * (np_ + 1) / 2) / (np_ * nn)
+
+
+def train(quant):
+    lines = []
+    log.register_callback(lines.append)
+    try:
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": 2, "tpu_quant_hist": quant},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+    finally:
+        log.register_callback(None)
+    events = [e for e in map(parse_event, lines) if e]
+    return auc(y, bst.predict(X)), events
+
+
+auc_off, _ = train("off")
+auc_on, events = train("on")
+qh = [e for e in events if e["event"] == "quant_hist"]
+assert qh, "tpu_quant_hist=on emitted no quant_hist event"
+assert qh[0]["bits"] == 16 and qh[0]["dtype"] == "int16", qh[0]
+assert abs(auc_on - auc_off) < 1e-3, (auc_on, auc_off)
+print(f"quantized hist smoke: ok (int16 AUC {auc_on:.5f} vs "
+      f"f32 {auc_off:.5f}, quant_hist event emitted)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "ingest artifacts kept under $ING_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$ING_DIR")"
+fi
+
 echo "== graftlint (invariant gate) =="
 # the real tree must be clean: exit 0, no new findings
 python -m tools.lint

@@ -237,8 +237,14 @@ class Dataset:
                     cats.append(feature_names.index(c))
                 else:
                     cats.append(int(c))
-        maker = (_CoreDataset.from_sparse if sparse_in
-                 else _CoreDataset.from_matrix)
+        if not sparse_in and int(getattr(cfg, "tpu_stream_chunk_rows",
+                                         0)) > 0:
+            # streaming out-of-core ingest: chunked device-side binning
+            # (io/stream.py), same sample draw -> same model bytes
+            from .io.stream import stream_matrix as maker
+        else:
+            maker = (_CoreDataset.from_sparse if sparse_in
+                     else _CoreDataset.from_matrix)
         self._handle = maker(
             mat, label=self.label, config=cfg, weight=self.weight,
             group=self.group, init_score=self.init_score,

@@ -111,6 +111,13 @@ class Application:
             predict_fun = lambda X: prior.predict(X, raw_score=True)  # noqa: E731
         loader = DatasetLoader(cfg, predict_fun=predict_fun)
         core = loader.load_from_file(cfg.data)
+        ing = getattr(core, "_ingest_stats", None)
+        if ing:
+            print(f"Streamed ingest: {ing['rows']} rows in chunks of "
+                  f"{ing['chunk_rows']} ({ing['device_cols']} "
+                  f"device-binned + {ing['host_cols']} host-binned "
+                  f"columns, "
+                  f"{getattr(core, '_ingest_ms', 0.0) / 1e3:.1f} s)")
         train_set = _wrap_core(core, self.raw_params)
         valid_sets, valid_names = [], []
         for vf in cfg.valid:

@@ -458,6 +458,38 @@ class Config:
     # faulted off the aligned path run aligned. Lower it to force the
     # spill ring (tests); raise it only on parts with more VMEM
     tpu_hist_spill_vmem_mb: float = 48.0
+    # rows per chunk for the streaming out-of-core ingest (io/stream.py).
+    # 0 (default) keeps today's paths: one-shot in-memory construction,
+    # or the host-side two_round push-rows flow when two_round=true.
+    # > 0 routes file loads AND in-memory matrix construction through
+    # the chunked streaming pipeline: one bounded sample pass computes
+    # bin boundaries (bitwise-equal to the single-host draw), then each
+    # chunk is binned ON DEVICE by a jitted searchsorted kernel and
+    # appended straight into the HBM-resident binned matrix — peak host
+    # memory stays O(chunk_rows), so datasets larger than host RAM
+    # train. The trained model is byte-equal to the in-memory path at
+    # the same sampled boundaries (runtime-only: not part of the model)
+    tpu_stream_chunk_rows: int = 0
+    # quantized gradient/hessian histogram accumulation on the MXU hist
+    # path: per-tree stochastic-rounded int8/int16 gradient quantization
+    # with per-leaf histogram rescale back to f32 units. Halves (int16)
+    # or quarters (int8) the per-leaf grad/hess gather traffic — the
+    # dominant HBM bandwidth term of the fused build program. "auto":
+    # quantize when a TPU is attached and the fused leaf-wise path with
+    # a bf16x2/pallas histogram runs; "on": quantize everywhere the
+    # fused path can run (CPU included — tests/CI; the aligned engine is
+    # gated off so the quantized fused path is actually exercised);
+    # "off": today's f32 payload path, bitwise-unchanged — the parity
+    # oracle, same fallback/oracle discipline as tpu_rank_fused. The
+    # exact-f64 and gpu_use_dp histogram modes never quantize
+    tpu_quant_hist: str = "auto"
+    # quantized-histogram integer width: 16 (default) or 8. int16
+    # payloads are exact under the bf16 hi/lo split (|q| <= 32767 needs
+    # 15 mantissa bits); int8 (|q| <= 127) is exact in a SINGLE bf16
+    # pass, so the hi/lo split collapses to one MXU issue — quarter the
+    # gather bytes and half the matmul work, at more rounding noise per
+    # tree (stochastic rounding keeps it unbiased)
+    tpu_quant_hist_bits: int = 16
     # directory for jax's persistent XLA compilation cache (or via the
     # LGBT_COMPILE_CACHE_DIR environment variable). Wired BEFORE any
     # program traces, with the min-compile-time floor dropped to 0 s

@@ -42,8 +42,21 @@ from ..io.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 __all__ = [
     "find_bin_mappers_distributed",
     "merged_sample",
+    "sample_indices",
     "shard_sample_indices",
 ]
+
+
+def sample_indices(n: int, sample_cnt: int, seed: int) -> np.ndarray:
+    """The canonical sorted bin-construction sample draw — byte-for-byte
+    the draw `Dataset.from_matrix` makes. Every sampling consumer
+    (single-host, distributed shards, the streaming ingest's bounded
+    sample pass) goes through THIS function so their boundaries are
+    bitwise-equal by construction, not by tolerance."""
+    rng = np.random.RandomState(seed)
+    if sample_cnt < n:
+        return np.sort(rng.choice(n, sample_cnt, replace=False))
+    return np.arange(n, dtype=np.int64)
 
 
 def shard_sample_indices(n: int, sample_cnt: int, seed: int,
@@ -51,11 +64,7 @@ def shard_sample_indices(n: int, sample_cnt: int, seed: int,
     """Per-shard GLOBAL sample indices: the single shared draw split by
     contiguous row block. ``concatenate(result)`` is exactly the sorted
     single-host sample index array."""
-    rng = np.random.RandomState(seed)
-    if sample_cnt < n:
-        idx = np.sort(rng.choice(n, sample_cnt, replace=False))
-    else:
-        idx = np.arange(n, dtype=np.int64)
+    idx = sample_indices(n, sample_cnt, seed)
     per = int(math.ceil(n / num_shards))
     return [idx[(idx >= s * per) & (idx < (s + 1) * per)]
             for s in range(num_shards)]

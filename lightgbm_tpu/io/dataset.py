@@ -150,6 +150,7 @@ class Dataset:
 
     def __init__(self) -> None:
         self.bundles = None
+        self._dev_bins = None  # HBM copy left behind by streaming ingest
         self.num_data: int = 0
         self.num_total_features: int = 0
         self.bins: Optional[np.ndarray] = None
@@ -514,6 +515,59 @@ class Dataset:
                                                       np.float64)
         self._push_pos = pos + k
 
+    def push_binned_rows(self, binned: np.ndarray, label=None, weight=None,
+                         init_score=None) -> None:
+        """Streaming creation, step 2 (pre-binned variant): append a chunk
+        that was ALREADY binned — the streaming ingest path
+        (`io/stream.py`) bins each chunk on device and pulls back uint8
+        rows, so the host never holds the raw float chunk AND its binned
+        copy twice. Same ordering/sidecar contract as :meth:`push_rows`."""
+        if getattr(self, "_push_pos", None) is None:
+            raise RuntimeError(
+                "push_binned_rows requires a dataset made by "
+                "create_from_sample")
+        binned = np.asarray(binned)
+        k = binned.shape[0]
+        pos = self._push_pos
+        if pos + k > self.num_data:
+            raise ValueError(
+                f"push_binned_rows overflow: {pos + k} > "
+                f"n_total={self.num_data}")
+        if binned.shape[1] != self.bins.shape[1]:
+            raise ValueError(
+                f"push_binned_rows width {binned.shape[1]} != "
+                f"{self.bins.shape[1]} used features")
+        self.bins[pos:pos + k] = binned.astype(self.bins.dtype, copy=False)
+        if label is not None:
+            if self._push_label is None:
+                self._push_label = np.zeros(self.num_data, np.float64)
+            self._push_label[pos:pos + k] = np.asarray(label, np.float64)
+        if weight is not None:
+            if self._push_weight is None:
+                self._push_weight = np.ones(self.num_data, np.float64)
+            self._push_weight[pos:pos + k] = np.asarray(weight, np.float64)
+        if init_score is not None:
+            if self._push_init is None:
+                self._push_init = np.zeros(self.num_data, np.float64)
+            self._push_init[pos:pos + k] = np.asarray(init_score,
+                                                      np.float64)
+        self._push_pos = pos + k
+
+    def attach_device_bins(self, dev_bins) -> None:
+        """Adopt an HBM-resident copy of ``bins`` built during streaming
+        ingest (io/stream.py) so the serial learner's first upload is a
+        no-op. Invalidated whenever the host matrix is rewritten (EFB
+        bundling, column merges)."""
+        self._dev_bins = dev_bins
+
+    def device_bins(self):
+        """The HBM copy of ``bins``: the streamed buffer when one is
+        attached and still valid, else a lazy upload of the host matrix."""
+        if getattr(self, "_dev_bins", None) is None:
+            import jax.numpy as jnp
+            self._dev_bins = jnp.asarray(self.bins)
+        return self._dev_bins
+
     def finish_load(self, group=None) -> "Dataset":
         """Streaming creation, step 3: seal the dataset (reference
         `Dataset::FinishLoad`, dataset.cpp:330): check the declared row
@@ -556,6 +610,7 @@ class Dataset:
                 db = np.asarray([self.mappers[j].default_bin for j in used],
                                 np.int32)
                 self.bins = apply_bundles(self.bins, self.bundles, db)
+                self._dev_bins = None  # streamed HBM copy is pre-bundle
             return
         self.bundles = None
         # Supported surface (v1): fused serial device learner with
@@ -587,6 +642,7 @@ class Dataset:
             return    # not worth the indirection
         self.bundles = info
         self.bins = apply_bundles(self.bins, info, db)
+        self._dev_bins = None  # streamed HBM copy is pre-bundle
 
     # ------------------------------------------------------------------
     def shard(self, mesh, axis_name: str = "data") -> Dict[str, Any]:
@@ -730,6 +786,7 @@ class Dataset:
             raise ValueError(
                 f"Cannot add features from a dataset with {other.num_data} "
                 f"rows to one with {self.num_data} rows")
+        self._dev_bins = None  # column merge rewrites the binned matrix
         off = self.num_total_features
         self.mappers = self.mappers + other.mappers
         self.feature_names = self.feature_names + other.feature_names

@@ -25,6 +25,10 @@ from .parser import LibSVMParser, create_parser, parse_dense
 # env override LGBM_TPU_INGEST_CHUNK
 DEFAULT_CHUNK_LINES = 1 << 16
 
+# columnar front-door extensions routed through io/stream.py's pyarrow
+# reader (gated: pyarrow is optional)
+_COLUMNAR_EXTS = (".parquet", ".arrow", ".feather", ".ipc")
+
 
 def _parse_column_spec(spec: str, names: Optional[List[str]]) -> List[int]:
     """``"0,1,2"`` or ``"name:a,b"`` -> column indices (feature space)."""
@@ -199,6 +203,12 @@ class DatasetLoader:
                 else filename + ".bin"
             if not cfg.save_binary and vf_exists(binpath):
                 return Dataset.load_binary(binpath)
+        if str(filename).endswith(_COLUMNAR_EXTS):
+            return self._load_columnar(filename, rank=rank,
+                                       num_machines=num_machines)
+        if int(getattr(cfg, "tpu_stream_chunk_rows", 0)) > 0:
+            return self._load_streamed(filename, rank=rank,
+                                       num_machines=num_machines)
         if getattr(cfg, "two_round", False):
             return self._load_two_round(filename, rank=rank,
                                         num_machines=num_machines)
@@ -435,10 +445,399 @@ class DatasetLoader:
             ds.save_binary(filename + ".bin")
         return ds
 
+    # ------------------------------------------------------------------
+    def _load_streamed(self, filename: str, rank: int = 0,
+                       num_machines: int = 1,
+                       reference: Optional[Dataset] = None,
+                       chunk_lines: Optional[int] = None) -> Dataset:
+        """Streaming out-of-core load (``tpu_stream_chunk_rows > 0``):
+        three bounded passes over the text file, model byte-equal to the
+        one-shot parse-everything route.
+
+        1. **count pass** — stream chunks to count kept rows (striping
+           applied) and, when the format demands it (LibSVM width,
+           in-file query ids), parse them; otherwise lines are only
+           counted.
+        2. **sample pass** — the canonical `from_matrix` index draw
+           (`dist.binning.sample_indices`) over the kept rows maps to
+           global LINE numbers, and ONLY those lines are parsed: the
+           sample matrix is identical to the slice the in-memory path
+           takes, so bin boundaries are bitwise-equal.
+        3. **bin pass** — each chunk is parsed, binned ON DEVICE
+           (`io/stream.DeviceBinner`), appended to the HBM buffer and
+           pulled back as uint8 rows into the preallocated host matrix.
+
+        Peak host float memory is O(sample + chunk); the raw matrix
+        never exists.
+        """
+        import time as _time
+
+        from ..dist.binning import sample_indices
+        from ..utils import log
+        from .stream import DeviceAppender, DeviceBinner
+
+        cfg = self.config
+        t0 = _time.perf_counter()
+        if chunk_lines is None:
+            chunk_lines = int(cfg.tpu_stream_chunk_rows) \
+                or int(os.environ.get("LGBM_TPU_INGEST_CHUNK",
+                                      DEFAULT_CHUNK_LINES))
+        chunk_lines = max(int(chunk_lines), 1)
+        if not vf_exists(filename):
+            raise FileNotFoundError(f"data file {filename} not found")
+        all_names = self._header_names(filename)
+        label_idx = self._resolve_label_idx(all_names)
+        feat_names = None
+        if all_names is not None:
+            feat_names = list(all_names)
+            if 0 <= label_idx < len(feat_names):
+                feat_names.pop(label_idx)
+        widx = gidx = None
+        ignore: set = set()
+        if str(cfg.weight_column).strip():
+            (widx,) = _parse_column_spec(cfg.weight_column, feat_names)
+            ignore.add(widx)
+        if str(cfg.group_column).strip():
+            (gidx,) = _parse_column_spec(cfg.group_column, feat_names)
+            ignore.add(gidx)
+        for c in _parse_column_spec(cfg.ignore_column, feat_names):
+            ignore.add(c)
+
+        def _prep_chunk(labs, feats, start_global):
+            """striping + metadata-column extraction + ignore zeroing —
+            identical to the two_round helper so every pass sees the
+            same kept rows."""
+            gi = start_global + np.arange(len(labs))
+            if num_machines > 1 and not cfg.pre_partition:
+                sel = gi % num_machines == rank
+                labs, feats, gi = labs[sel], feats[sel], gi[sel]
+            w = feats[:, widx].copy() if widx is not None \
+                and widx < feats.shape[1] else None
+            gids = feats[:, gidx].copy() if gidx is not None \
+                and gidx < feats.shape[1] else None
+            for c in ignore:
+                if c < feats.shape[1]:
+                    feats[:, c] = 0.0
+            return labs, feats, w, gids, gi
+
+        # ---- pass 1: count (parse only when the format demands it)
+        parser = None
+        gid_parts: List[np.ndarray] = []
+        n_global = 0
+        n_kept = 0
+        max_f = 0
+        needs_parse = True
+        for lines in self._iter_line_chunks(filename, chunk_lines):
+            if parser is None:
+                parser = create_parser(lines[:32], label_idx)
+                # delimited formats have a fixed width and (unless a
+                # group column is in-file) nothing else to extract, so
+                # later pass-1 chunks are just counted
+                needs_parse = (isinstance(parser, LibSVMParser)
+                               or gidx is not None)
+                max_f = parser.num_features(lines[0])
+            if needs_parse:
+                labs, feats = parse_dense(lines, parser)
+                labs, feats, _w, gids, _gi = _prep_chunk(labs, feats,
+                                                         n_global)
+                max_f = max(max_f, feats.shape[1])
+                if gids is not None:
+                    gid_parts.append(gids)
+                kept = feats.shape[0]
+            else:
+                gi = n_global + np.arange(len(lines))
+                kept = len(lines) if num_machines <= 1 \
+                    or cfg.pre_partition \
+                    else int(np.sum(gi % num_machines == rank))
+            n_global += len(lines)
+            n_kept += kept
+        if parser is None:
+            raise ValueError(f"data file {filename} is empty")
+
+        num_cols = max_f if isinstance(parser, LibSVMParser) else None
+
+        # ---- pass 2: bounded sample — the canonical from_matrix draw
+        if reference is not None:
+            max_f = max(max_f, reference.num_total_features)
+            num_cols = max_f if isinstance(parser, LibSVMParser) else None
+            ds = Dataset.create_from_sample(None, n_kept, config=cfg,
+                                            reference=reference)
+        else:
+            sample_cnt = min(n_kept, max(cfg.bin_construct_sample_cnt, 1))
+            sidx = np.asarray(
+                sample_indices(n_kept, sample_cnt, cfg.data_random_seed),
+                np.int64)
+            # kept row i lives at a computable global line: identity when
+            # not striping, rank + i * num_machines otherwise
+            if num_machines > 1 and not cfg.pre_partition:
+                want_global = sidx * num_machines + rank
+            else:
+                want_global = sidx
+            picked: List[str] = []
+            off = 0
+            for lines in self._iter_line_chunks(filename, chunk_lines):
+                lo = np.searchsorted(want_global, off)
+                hi = np.searchsorted(want_global, off + len(lines))
+                for g in want_global[lo:hi]:
+                    picked.append(lines[int(g - off)])
+                off += len(lines)
+            _, sample = parse_dense(picked, parser, num_cols=num_cols)
+            del picked
+            if sample.shape[1] < max_f:
+                sample = np.pad(
+                    sample, ((0, 0), (0, max_f - sample.shape[1])))
+            for c in ignore:
+                if c < sample.shape[1]:
+                    sample[:, c] = 0.0
+            ds = Dataset.create_from_sample(
+                sample, n_kept, config=cfg, feature_names=feat_names,
+                categorical_feature=self._categorical_from_config(
+                    feat_names))
+            del sample
+
+        # ---- pass 3: parse + device-bin + append chunk-by-chunk
+        side_w = _read_sidecar(filename + ".weight")
+        side_q = _read_sidecar(filename + ".query")
+        init_score = _read_sidecar(filename + ".init")
+        if cfg.initscore_filename and vf_exists(cfg.initscore_filename):
+            init_score = _read_sidecar(cfg.initscore_filename)
+        binner = DeviceBinner(ds, chunk_lines)
+        appender = (DeviceAppender(n_kept, binner.num_used, chunk_lines,
+                                   ds.bins.dtype)
+                    if binner.num_used else None)
+        pos = 0
+        n_global = 0
+        raw_parts: List[np.ndarray] = []
+        kept_gi: List[np.ndarray] = []
+        for lines in self._iter_line_chunks(filename, chunk_lines):
+            labs, feats = parse_dense(lines, parser, num_cols=num_cols)
+            labs, feats, w, _, gi = _prep_chunk(labs, feats, n_global)
+            n_global += len(lines)
+            if feats.shape[1] < max_f:
+                feats = np.pad(feats,
+                               ((0, 0), (0, max_f - feats.shape[1])))
+            k = feats.shape[0]
+            if side_w is not None:
+                w = side_w[gi]
+            if binner.num_used:
+                dev = binner.bin_chunk(feats)
+                appender.append(dev, k)
+                host_rows = np.asarray(dev)[:k]
+            else:
+                host_rows = np.zeros((k, 0), ds.bins.dtype)
+            ds.push_binned_rows(host_rows, label=labs, weight=w)
+            if init_score is None and self.predict_fun is not None:
+                raw_parts.append(np.asarray(self.predict_fun(feats),
+                                            np.float64))
+            kept_gi.append(gi)
+            pos += k
+        if pos != n_kept:
+            raise ValueError(
+                f"streamed load pass 3 saw {pos} rows but pass 1 counted "
+                f"{n_kept}: the data file changed between passes (is the "
+                f"path a non-rewindable stream?)")
+
+        group_sizes = None
+        if side_q is not None:
+            group_sizes = side_q.astype(np.int64)
+        elif gid_parts:
+            ids = np.concatenate(gid_parts)
+            change = np.flatnonzero(np.diff(ids) != 0)
+            bounds = np.concatenate([[0], change + 1, [len(ids)]])
+            group_sizes = np.diff(bounds).astype(np.int64)
+        if appender is not None:
+            ds.attach_device_bins(appender.finish())
+        ds.finish_load(group=group_sizes)
+        if init_score is not None:
+            gsel = (np.concatenate(kept_gi) if kept_gi
+                    else np.zeros(0, np.int64))
+            if n_global and len(init_score) % n_global == 0:
+                ncls = len(init_score) // n_global
+                ds.metadata.set_init_score(np.concatenate(
+                    [init_score[c * n_global + gsel]
+                     for c in range(ncls)]))
+            else:
+                ds.metadata.set_init_score(init_score)
+        elif raw_parts:
+            raw = np.concatenate(raw_parts, axis=0)
+            ds.metadata.set_init_score(raw.reshape(-1, order="F"))
+        ms = (_time.perf_counter() - t0) * 1e3
+        ds._ingest_ms = ms
+        ds._ingest_stats = {
+            "rows": int(n_kept), "chunk_rows": int(chunk_lines),
+            "device_cols": int(binner.num_used - len(binner._cat_cols)),
+            "host_cols": int(len(binner._cat_cols)),
+        }
+        log.event("stream_ingest", rows=int(n_kept),
+                  chunk_rows=int(chunk_lines),
+                  device_cols=ds._ingest_stats["device_cols"],
+                  host_cols=ds._ingest_stats["host_cols"],
+                  ingest_ms=ms, source="file")
+        if cfg.save_binary:
+            ds.save_binary(filename + ".bin")
+        return ds
+
+    # ------------------------------------------------------------------
+    def _load_columnar(self, filename: str, rank: int = 0,
+                       num_machines: int = 1,
+                       reference: Optional[Dataset] = None) -> Dataset:
+        """Parquet / Arrow IPC front door: record batches of
+        ``tpu_stream_chunk_rows`` stream through the same sample +
+        device-bin + append flow as `_load_streamed`. Requires pyarrow
+        (gated — a clear ImportError otherwise)."""
+        import time as _time
+
+        from ..dist.binning import sample_indices
+        from ..utils import log
+        from .stream import (DeviceAppender, DeviceBinner,
+                             iter_parquet_batches)
+
+        cfg = self.config
+        t0 = _time.perf_counter()
+        chunk_rows = max(int(cfg.tpu_stream_chunk_rows)
+                         or DEFAULT_CHUNK_LINES, 1)
+        if not os.path.exists(filename):
+            raise FileNotFoundError(f"data file {filename} not found")
+
+        names: Optional[List[str]] = None
+        n_global = 0
+        for batch_names, block in iter_parquet_batches(filename,
+                                                       chunk_rows):
+            names = batch_names
+            n_global += block.shape[0]
+        if names is None or n_global == 0:
+            raise ValueError(f"data file {filename} is empty")
+        label_idx = self._resolve_label_idx(names)
+        feat_names = list(names)
+        if 0 <= label_idx < len(feat_names):
+            feat_names.pop(label_idx)
+        widx = gidx = None
+        ignore: set = set()
+        if str(cfg.weight_column).strip():
+            (widx,) = _parse_column_spec(cfg.weight_column, feat_names)
+            ignore.add(widx)
+        if str(cfg.group_column).strip():
+            (gidx,) = _parse_column_spec(cfg.group_column, feat_names)
+            ignore.add(gidx)
+        for c in _parse_column_spec(cfg.ignore_column, feat_names):
+            ignore.add(c)
+
+        def _prep_block(block, start_global):
+            labs = block[:, label_idx].copy() \
+                if 0 <= label_idx < block.shape[1] \
+                else np.zeros(block.shape[0])
+            feats = np.delete(block, label_idx, axis=1) \
+                if 0 <= label_idx < block.shape[1] else block
+            gi = start_global + np.arange(len(labs))
+            if num_machines > 1 and not cfg.pre_partition:
+                sel = gi % num_machines == rank
+                labs, feats, gi = labs[sel], feats[sel], gi[sel]
+            w = feats[:, widx].copy() if widx is not None else None
+            gids = feats[:, gidx].copy() if gidx is not None else None
+            for c in ignore:
+                if c < feats.shape[1]:
+                    feats[:, c] = 0.0
+            return labs, feats, w, gids, gi
+
+        stripe = num_machines > 1 and not cfg.pre_partition
+        n_kept = (int(np.sum(np.arange(n_global) % num_machines == rank))
+                  if stripe else n_global)
+
+        if reference is not None:
+            ds = Dataset.create_from_sample(None, n_kept, config=cfg,
+                                            reference=reference)
+        else:
+            sample_cnt = min(n_kept, max(cfg.bin_construct_sample_cnt, 1))
+            sidx = np.asarray(
+                sample_indices(n_kept, sample_cnt, cfg.data_random_seed),
+                np.int64)
+            want = sidx * num_machines + rank if stripe else sidx
+            rows: List[np.ndarray] = []
+            off = 0
+            gid_parts: List[np.ndarray] = []
+            for _, block in iter_parquet_batches(filename, chunk_rows):
+                labs, feats, _w, gids, gi = _prep_block(block, off)
+                lo = np.searchsorted(want, off)
+                hi = np.searchsorted(want, off + block.shape[0])
+                if hi > lo:
+                    rows.append(feats[np.searchsorted(gi, want[lo:hi])])
+                if gids is not None:
+                    gid_parts.append(gids)
+                off += block.shape[0]
+            sample = (np.concatenate(rows, axis=0) if rows
+                      else np.zeros((0, max(len(feat_names), 0))))
+            del rows
+            ds = Dataset.create_from_sample(
+                sample, n_kept, config=cfg, feature_names=feat_names,
+                categorical_feature=self._categorical_from_config(
+                    feat_names))
+            del sample
+
+        side_w = _read_sidecar(filename + ".weight")
+        side_q = _read_sidecar(filename + ".query")
+        init_score = _read_sidecar(filename + ".init")
+        binner = DeviceBinner(ds, chunk_rows)
+        appender = (DeviceAppender(n_kept, binner.num_used, chunk_rows,
+                                   ds.bins.dtype)
+                    if binner.num_used else None)
+        pos = 0
+        off = 0
+        gid_parts = []
+        for _, block in iter_parquet_batches(filename, chunk_rows):
+            labs, feats, w, gids, gi = _prep_block(block, off)
+            off += block.shape[0]
+            k = feats.shape[0]
+            if side_w is not None:
+                w = side_w[gi]
+            if gids is not None:
+                gid_parts.append(gids)
+            if binner.num_used:
+                dev = binner.bin_chunk(feats)
+                appender.append(dev, k)
+                host_rows = np.asarray(dev)[:k]
+            else:
+                host_rows = np.zeros((k, 0), ds.bins.dtype)
+            ds.push_binned_rows(host_rows, label=labs, weight=w)
+            pos += k
+        if pos != n_kept:
+            raise ValueError(
+                f"columnar load saw {pos} rows but the count pass saw "
+                f"{n_kept}: the file changed between passes")
+        group_sizes = None
+        if side_q is not None:
+            group_sizes = side_q.astype(np.int64)
+        elif gid_parts:
+            ids = np.concatenate(gid_parts)
+            change = np.flatnonzero(np.diff(ids) != 0)
+            bounds = np.concatenate([[0], change + 1, [len(ids)]])
+            group_sizes = np.diff(bounds).astype(np.int64)
+        if appender is not None:
+            ds.attach_device_bins(appender.finish())
+        ds.finish_load(group=group_sizes)
+        if init_score is not None:
+            ds.metadata.set_init_score(init_score)
+        ms = (_time.perf_counter() - t0) * 1e3
+        ds._ingest_ms = ms
+        ds._ingest_stats = {
+            "rows": int(n_kept), "chunk_rows": int(chunk_rows),
+            "device_cols": int(binner.num_used - len(binner._cat_cols)),
+            "host_cols": int(len(binner._cat_cols)),
+        }
+        log.event("stream_ingest", rows=int(n_kept),
+                  chunk_rows=int(chunk_rows),
+                  device_cols=ds._ingest_stats["device_cols"],
+                  host_cols=ds._ingest_stats["host_cols"],
+                  ingest_ms=ms, source="columnar")
+        return ds
+
     def load_from_file_align_with_other_dataset(
             self, filename: str, reference: Dataset) -> Dataset:
         """Validation data binned with the training set's mappers
         (reference `dataset_loader.cpp:224`)."""
+        if str(filename).endswith(_COLUMNAR_EXTS):
+            return self._load_columnar(filename, reference=reference)
+        if int(getattr(self.config, "tpu_stream_chunk_rows", 0)) > 0:
+            return self._load_streamed(filename, reference=reference)
         if getattr(self.config, "two_round", False):
             return self._load_two_round(filename, reference=reference)
         labels, feats, ex = self.parse_file(filename)

@@ -1,0 +1,304 @@
+"""Streaming out-of-core ingest with device-side binning.
+
+The one-shot construct paths (`Dataset.from_matrix`, the loader's
+parse-everything route) materialize the full float matrix on the host —
+at Higgs scale that is an 11M x 28 f64 intermediate for a dataset whose
+training copy is a 308 MB uint8 matrix. This module replaces that
+intermediate with a chunked pipeline:
+
+1. **one bounded sample pass** draws the bin-construction sample with
+   the SAME canonical index draw as `Dataset.from_matrix`
+   (`dist.binning.sample_indices`), so the resulting bin boundaries are
+   bitwise-equal to the in-memory path's — parity by construction, the
+   same argument the distributed bin sync makes;
+2. **each chunk is binned on device**: a jitted f64 `searchsorted` over
+   per-feature upper-bound tables (the device twin of
+   `BinMapper.values_to_bins`; categorical columns are dictionary
+   lookups and stay host-binned, riding through the kernel untouched);
+3. the binned uint8 rows are appended into an HBM-resident buffer
+   (donated `dynamic_update_slice`, O(1) reallocation) AND pulled back
+   chunk-by-chunk into the host matrix the rest of the stack reads
+   (model text, bundling, binary save). The HBM buffer is attached to
+   the dataset so the learner's first upload is free.
+
+Peak host memory is O(sample + chunk + uint8 matrix) — the raw float
+matrix never exists, so datasets whose FLOAT form exceeds host RAM
+load fine as long as their binned form fits.
+
+Arrow/Parquet front door: `iter_parquet_batches` reads record batches
+of ~chunk rows through pyarrow when it is installed (gated import — the
+toolchain does not bake it in; callers get a clear error otherwise).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import Config
+from .binning import BIN_CATEGORICAL, MISSING_NAN
+from .dataset import Dataset
+
+__all__ = [
+    "DeviceBinner",
+    "DeviceAppender",
+    "iter_parquet_batches",
+    "pyarrow_available",
+    "stream_matrix",
+]
+
+
+# ---------------------------------------------------------------------------
+# device-side value->bin kernel
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("out_bits",))
+def _bin_chunk_kernel(vals_T, bounds, is_cat, nan_override, use_override,
+                      out_bits: int):
+    """Device twin of `BinMapper.values_to_bins` for one padded chunk.
+
+    vals_T:       f64 [U, C] — chunk values, feature-major (categorical
+                  columns already hold HOST bin ids)
+    bounds:       f64 [U, Bmax] — truncated `bin_upper_bound[:r]` padded
+                  with +inf (past-the-end searches land exactly on r,
+                  the first pad index, so padding is bitwise-equivalent
+                  to the host's per-column truncation)
+    nan_override: int32 [U] — `num_bin - 1` for MISSING_NAN columns
+    use_override: bool [U] — whether NaN routes to nan_override (else
+                  NaN is binned as 0.0, matching the host)
+
+    The comparisons run in f64 — the exactness of the host parity
+    argument lives or dies on the compare precision, so the CALLER must
+    trace/lower/run this under `enable_x64` (a ctx inside the traced
+    body is not enough: weak constants re-canonicalize to f32 at
+    lowering time, which happens after the body ctx has exited).
+    """
+    nan_mask = jnp.isnan(vals_T)
+    v = jnp.where(nan_mask, jnp.zeros((), vals_T.dtype), vals_T)
+    idx = jax.vmap(
+        lambda b, c: jnp.searchsorted(b, c, side="left"))(bounds, v)
+    idx = idx.astype(jnp.int32)
+    idx = jnp.where(nan_mask & use_override[:, None],
+                    nan_override[:, None], idx)
+    # categorical columns arrived host-binned: pass the ids through
+    idx = jnp.where(is_cat[:, None], v.astype(jnp.int32), idx)
+    out_dtype = jnp.uint8 if out_bits == 8 else jnp.uint16
+    return idx.T.astype(out_dtype)
+
+
+class DeviceBinner:
+    """Per-dataset binning tables + the jitted chunk kernel.
+
+    Chunks are padded to a fixed ``chunk_rows`` so ONE trace serves the
+    whole ingest; the garbage pad rows are sliced off on the host side
+    and overwritten by the next append on the device side.
+    """
+
+    def __init__(self, ds: Dataset, chunk_rows: int) -> None:
+        self.chunk_rows = int(chunk_rows)
+        self.used = np.asarray(ds.real_feature_idx)
+        mappers = [ds.mappers[j] for j in self.used]
+        self.out_bits = 8 if ds.bins.dtype == np.uint8 else 16
+        u = len(mappers)
+        self.num_used = u
+        self._cat_cols = [i for i, m in enumerate(mappers)
+                          if m.bin_type == BIN_CATEGORICAL]
+        self._mappers = mappers
+        if u == 0:
+            return
+        rs = []
+        for m in mappers:
+            if m.bin_type == BIN_CATEGORICAL:
+                rs.append(0)
+            else:
+                r = m.num_bin - 1
+                if m.missing_type == MISSING_NAN:
+                    r -= 1
+                rs.append(max(r, 0))
+        bmax = max(max(rs), 1)
+        bounds = np.full((u, bmax), np.inf, dtype=np.float64)
+        for i, (m, r) in enumerate(zip(mappers, rs)):
+            if r > 0:
+                bounds[i, :r] = np.asarray(m.bin_upper_bound[:r], np.float64)
+        with jax.experimental.enable_x64():
+            # f64 on device: created inside enable_x64 so the dtype
+            # survives canonicalization (a plain asarray would silently
+            # downcast to f32 and break bitwise parity with the host)
+            self._bounds = jnp.asarray(bounds, dtype=jnp.float64)
+        self._is_cat = jnp.asarray(
+            np.asarray([m.bin_type == BIN_CATEGORICAL for m in mappers]))
+        self._nan_override = jnp.asarray(
+            np.asarray([m.num_bin - 1 for m in mappers], np.int32))
+        self._use_override = jnp.asarray(
+            np.asarray([m.bin_type != BIN_CATEGORICAL
+                        and m.missing_type == MISSING_NAN
+                        for m in mappers]))
+
+    def bin_chunk(self, feats: np.ndarray):
+        """Bin one [k, F_total] float chunk -> device [chunk_rows, U]
+        (rows past k are pad garbage). Returns the DEVICE array; callers
+        slice/pull as needed."""
+        k = feats.shape[0]
+        vals = np.ascontiguousarray(
+            np.asarray(feats, np.float64)[:, self.used].T)  # [U, k]
+        for i in self._cat_cols:
+            # categorical: host dictionary lookup, ids ride through
+            vals[i] = self._mappers[i].values_to_bins(vals[i])
+        if k < self.chunk_rows:
+            vals = np.pad(vals, ((0, 0), (0, self.chunk_rows - k)))
+        # trace, lower AND run inside the x64 ctx: the jit cache keys on
+        # the x64 flag, so every call staying inside the ctx reuses one
+        # genuinely-f64 program
+        with jax.experimental.enable_x64():
+            vals_dev = jnp.asarray(vals, dtype=jnp.float64)
+            return _bin_chunk_kernel(vals_dev, self._bounds, self._is_cat,
+                                     self._nan_override,
+                                     self._use_override, self.out_bits)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _append_kernel(buf, chunk, pos):
+    """Donated in-place append: the buffer is over-allocated by one full
+    chunk, so `pos + chunk_rows <= buf_rows` always holds and the update
+    never clamps; garbage pad rows are overwritten by the next append
+    and sliced off at finish."""
+    return lax.dynamic_update_slice(buf, chunk, (pos, jnp.int32(0)))
+
+
+class DeviceAppender:
+    """HBM-resident growing copy of the binned matrix ([n + chunk, U]
+    buffer, donated fixed-size appends, final [:n] slice)."""
+
+    def __init__(self, n: int, num_used: int, chunk_rows: int,
+                 dtype) -> None:
+        self.n = int(n)
+        self._buf = jnp.zeros((self.n + int(chunk_rows), num_used),
+                              dtype=jnp.uint8 if dtype == np.uint8
+                              else jnp.uint16)
+        self._pos = 0
+
+    def append(self, chunk_dev, k: int) -> None:
+        self._buf = _append_kernel(self._buf, chunk_dev,
+                                   jnp.int32(self._pos))
+        self._pos += int(k)
+
+    def finish(self):
+        if self._pos != self.n:
+            raise ValueError(
+                f"DeviceAppender: {self._pos} rows appended, "
+                f"{self.n} declared")
+        return self._buf[:self.n]
+
+
+# ---------------------------------------------------------------------------
+# in-memory matrix front door
+# ---------------------------------------------------------------------------
+def stream_matrix(data, label=None, config: Optional[Config] = None,
+                  weight=None, group=None, init_score=None,
+                  feature_names: Optional[List[str]] = None,
+                  categorical_feature: Optional[Sequence[int]] = None,
+                  reference: Optional[Dataset] = None) -> Dataset:
+    """Chunked twin of `Dataset.from_matrix`: same sample draw, same bin
+    boundaries, same binned matrix — but built chunk-by-chunk through the
+    device binning kernel, leaving the HBM copy attached. `data` may be
+    any object supporting 2-D shape + row slicing (an `np.memmap` of a
+    larger-than-RAM matrix is the intended caller)."""
+    from ..dist.binning import sample_indices
+    from ..utils import log
+
+    cfg = config or Config()
+    chunk_rows = max(int(cfg.tpu_stream_chunk_rows), 1)
+    t0 = time.perf_counter()
+    n, f = data.shape[0], data.shape[1]
+
+    if reference is not None:
+        ds = Dataset.create_from_sample(None, n, config=cfg,
+                                        reference=reference)
+    else:
+        sample_cnt = min(n, max(cfg.bin_construct_sample_cnt, 1))
+        sample_idx = sample_indices(n, sample_cnt, cfg.data_random_seed)
+        sample = np.asarray(data[sample_idx], np.float64)
+        ds = Dataset.create_from_sample(
+            sample, n, config=cfg, feature_names=feature_names,
+            categorical_feature=categorical_feature)
+        del sample
+
+    label = None if label is None else np.asarray(label).reshape(-1)
+    weight = None if weight is None else np.asarray(weight).reshape(-1)
+    binner = DeviceBinner(ds, chunk_rows)
+    appender = (DeviceAppender(n, binner.num_used, chunk_rows,
+                               ds.bins.dtype)
+                if binner.num_used else None)
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        k = hi - lo
+        if binner.num_used:
+            dev = binner.bin_chunk(np.asarray(data[lo:hi]))
+            appender.append(dev, k)
+            host = np.asarray(dev)[:k]
+        else:
+            host = np.zeros((k, 0), ds.bins.dtype)
+        ds.push_binned_rows(
+            host,
+            label=None if label is None else label[lo:hi],
+            weight=None if weight is None else weight[lo:hi])
+    if appender is not None:
+        ds.attach_device_bins(appender.finish())
+    ds.finish_load(group=group)
+    if init_score is not None:
+        ds.metadata.set_init_score(init_score)
+    ms = (time.perf_counter() - t0) * 1e3
+    ds._ingest_ms = ms
+    ds._ingest_stats = {
+        "rows": int(n), "chunk_rows": int(chunk_rows),
+        "device_cols": int(binner.num_used - len(binner._cat_cols)),
+        "host_cols": int(len(binner._cat_cols)),
+    }
+    log.event("stream_ingest", rows=int(n), chunk_rows=int(chunk_rows),
+              device_cols=ds._ingest_stats["device_cols"],
+              host_cols=ds._ingest_stats["host_cols"],
+              ingest_ms=ms, source="matrix")
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# Arrow / Parquet front door (gated: pyarrow is not baked into the image)
+# ---------------------------------------------------------------------------
+def pyarrow_available() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def iter_parquet_batches(path: str, chunk_rows: int
+                         ) -> Iterator[Tuple[List[str], np.ndarray]]:
+    """Yield ``(column_names, float64 [<=chunk_rows, C] block)`` from a
+    Parquet or Arrow IPC file without materializing the whole table."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except Exception as e:  # pragma: no cover - exercised via skipif
+        raise ImportError(
+            "Parquet/Arrow ingest needs pyarrow, which is not installed "
+            "in this environment; convert the file to CSV/TSV or install "
+            "pyarrow") from e
+    if str(path).endswith((".arrow", ".feather", ".ipc")):
+        with pa.memory_map(str(path)) as src:
+            table = pa.ipc.open_file(src).read_all()
+        batches = table.to_batches(max_chunksize=chunk_rows)
+    else:
+        pf = pq.ParquetFile(str(path))
+        batches = pf.iter_batches(batch_size=chunk_rows)
+    for batch in batches:
+        names = list(batch.schema.names)
+        cols = [np.asarray(batch.column(i).to_numpy(zero_copy_only=False),
+                           np.float64) for i in range(batch.num_columns)]
+        yield names, (np.stack(cols, axis=1) if cols
+                      else np.zeros((batch.num_rows, 0)))

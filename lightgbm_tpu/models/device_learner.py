@@ -44,7 +44,8 @@ from jax import lax
 from .. import compile_cache
 from ..config import Config
 from ..io.dataset import Dataset
-from ..ops.histogram import NUM_HIST_STATS, histogram_from_gathered_gh
+from ..ops.histogram import (NUM_HIST_STATS, histogram_from_gathered_gh,
+                             quantize_gh)
 from ..ops.partition import (categorical_goes_left, leaf_value_fill,
                              numerical_goes_left, split_partition,
                              unpermute_to_rows)
@@ -254,6 +255,8 @@ class DeviceTreeLearner:
         else:
             self.hist_precision = "bf16x2"
         self.min_pad = int(cfg.tpu_min_pad)
+        self.quant_bits, self._quant_why = self._resolve_quant_bits(cfg)
+        self._qseq = 0  # host counter: one fresh quantization key per tree
         # device feature metadata for the partition step
         self._nb_dev = jnp.asarray(meta["num_bin"], jnp.int32)
         self._db_dev = jnp.asarray(meta["default_bin"], jnp.int32)
@@ -284,6 +287,41 @@ class DeviceTreeLearner:
             self._boff_dev = jnp.zeros(self.num_features, jnp.int32)
             self._bpk_dev = jnp.zeros(self.num_features, jnp.int32)
 
+    def _resolve_quant_bits(self, cfg: Config) -> Tuple[int, Optional[str]]:
+        """Resolve ``tpu_quant_hist`` to active bits (0 = f32 oracle) plus
+        the human-readable reason when the oracle runs instead. The f32
+        path is bitwise-unchanged when inactive — same discipline as
+        ``tpu_rank_fused``; `gbdt._log_train_path` surfaces the outcome as
+        a ``quant_hist`` event once the actual train path is known."""
+        mode = str(cfg.tpu_quant_hist).lower()
+        if mode == "off":
+            return 0, "tpu_quant_hist=off"
+        bits = 8 if int(cfg.tpu_quant_hist_bits) == 8 else 16
+        if self.hist_precision in ("f64", "f32"):
+            # exact-f64 distributed parity and the gpu_use_dp double path
+            # must keep full-precision payloads
+            return 0, f"hist_precision={self.hist_precision} never quantizes"
+        if self.parallel_mode != "serial":
+            # data_parallel.py wraps build entries in shard_map with
+            # fixed-arity in_specs; the quantized entries take an extra
+            # qseq operand, so the parallel learners keep the f32 path
+            return 0, f"parallel_mode={self.parallel_mode} keeps f32 payloads"
+        if cfg.tpu_grow_mode == "level":
+            # the level builder's packed-word hist path bypasses
+            # _make_build_fn entirely
+            return 0, "tpu_grow_mode=level keeps f32 payloads"
+        if mode == "on":
+            return bits, None
+        if jax.default_backend() == "tpu":
+            return bits, None
+        return 0, "auto: no TPU attached"
+
+    def _next_qseq(self) -> int:
+        """Fresh per-tree quantization sequence number (host counter,
+        passed as a traced int32 so advancing it never retraces)."""
+        self._qseq += 1
+        return self._qseq
+
     def trace_signature(self) -> Tuple:
         """Hashable key covering everything this learner's build-program
         closures bake into a jax trace: the full config, the binning
@@ -311,7 +349,7 @@ class DeviceTreeLearner:
                    self.num_real_features, self.max_bin_global,
                    self.hist_bins, self.axis_name, self.parallel_mode,
                    self.mesh_size, self.min_pad, self.hist_precision,
-                   forced)
+                   self.quant_bits, forced)
             self._trace_sig_cache = sig
         return sig
 
@@ -328,7 +366,12 @@ class DeviceTreeLearner:
     @property
     def bins_dev(self) -> jax.Array:
         if self._bins_dev is None:
-            self._bins_dev = jnp.asarray(self.ds.bins)
+            # device_bins() reuses the HBM buffer the streaming ingest
+            # left behind (io/stream.py) — no second upload of the full
+            # binned matrix at train start
+            dev = getattr(self.ds, "device_bins", None)
+            self._bins_dev = dev() if dev is not None \
+                else jnp.asarray(self.ds.bins)
             from ..obs import memory as obs_memory
             obs_memory.track(
                 "train/bins_dev", self,
@@ -497,6 +540,34 @@ class DeviceTreeLearner:
         nb_dev, db_dev, mt_dev = self._nb_dev, self._db_dev, self._mt_dev
         chunk = int(cfg.tpu_hist_chunk)
         precision = self.hist_precision
+        # ---- quantized histogram payload (tpu_quant_hist): gradients are
+        # stochastic-rounded to int8/int16 ONCE per tree, so every per-leaf
+        # gather moves quarter/half the f32 bytes; finished histograms and
+        # root sums are rescaled back to gradient units by the pack scale.
+        # int8 fits a SINGLE bf16 pass exactly (|q| <= 127), so the hi/lo
+        # split is dropped too — half the MXU work on top of the bandwidth.
+        quant_bits = self.quant_bits
+        quant_on = quant_bits > 0
+        if quant_on and quant_bits == 8 and precision == "bf16x2":
+            precision = "bf16"
+        qseed = int(cfg.data_random_seed)
+        # mutable closure slot for the per-call pack scale (same pattern as
+        # coupled_box below): set when the entry packs the payload, read by
+        # the hist/sum rescale sites inside the same trace
+        qscale_box = [jnp.ones((2,), jnp.float32)]
+
+        def _gh_payload(grad, hess, opt):
+            """Stack (and optionally quantize) the [N, 2] payload; returns
+            (gh, remaining_opt) with the qseq operand consumed."""
+            gh = jnp.stack([grad, hess], axis=1)
+            if not quant_on:
+                return gh, opt
+            qseq, opt = opt[0], opt[1:]
+            key = jax.random.fold_in(jax.random.PRNGKey(qseed), qseq)
+            q, scale = quantize_gh(gh, quant_bits, key)
+            qscale_box[0] = scale
+            return q, opt
+
         depth_limit = self._depth_limit
         mono_dev = jnp.asarray(self.meta["monotone"], jnp.int32)
 
@@ -600,8 +671,14 @@ class DeviceTreeLearner:
 
         def _feature_block_hist(rows, gh, valid):
             if mode != "feature":
-                return histogram_from_gathered_gh(rows, gh, valid, BH,
-                                                  chunk, precision)
+                h = histogram_from_gathered_gh(rows, gh, valid, BH,
+                                               chunk, precision)
+                if quant_on:
+                    # back to gradient units: grad/hess columns by the pack
+                    # scale, count column untouched (exact integers)
+                    h = h * jnp.concatenate(
+                        [qscale_box[0], jnp.ones((1,), jnp.float32)])
+                return h
             # feature-parallel: each shard histograms only its feature block
             # (reference feature_parallel_tree_learner.cpp:33-52 work
             # division); the psum that follows assembles the global
@@ -688,11 +765,15 @@ class DeviceTreeLearner:
 
         coupled_box = [jnp.zeros((F,), jnp.float32)]
 
-        def build_fresh(bins, bins_T, grad, hess, feature_mask_f32,
-                        coupled_eff=None):
+        def build_fresh(bins, bins_T, grad, hess, feature_mask_f32, *opt):
             """Fresh-tree entry: creates the identity partition internally
             (one fused program instead of init-partition + build
-            dispatches); only valid without bagging."""
+            dispatches); only valid without bagging.
+
+            Trailing variadic operands, in order: the per-tree qseq (when
+            quant_on) then coupled_eff (when coupled CEGB is on) — both
+            consumed positionally so the donation/in_specs plumbing never
+            sees optional keywords."""
             n_pad = per_shard_rows + max(_pow2ceil(per_shard_rows),
                                          self.min_pad)
             pos = jnp.arange(n_pad, dtype=jnp.int32)
@@ -703,15 +784,15 @@ class DeviceTreeLearner:
             else:
                 cnt = jnp.int32(per_shard_rows)
             indices = jnp.where(pos < cnt, pos, 0)
-            gh = jnp.stack([grad, hess], axis=1)
+            gh, opt = _gh_payload(grad, hess, opt)
             return _build(bins, bins_T, indices, gh, cnt, feature_mask_f32,
-                          coupled_eff)
+                          *opt)
 
         def build(bins, bins_T, indices, grad, hess, root_count,
-                  feature_mask_f32, coupled_eff=None):
-            gh = jnp.stack([grad, hess], axis=1)
+                  feature_mask_f32, *opt):
+            gh, opt = _gh_payload(grad, hess, opt)
             return _build(bins, bins_T, indices, gh, root_count,
-                          feature_mask_f32, coupled_eff)
+                          feature_mask_f32, *opt)
 
         def _build(bins, bins_T, indices, gh, root_count, feature_mask_f32,
                    coupled_eff=None):
@@ -785,7 +866,8 @@ class DeviceTreeLearner:
                 rows = lax.slice(bins, (0, 0), (rp, bins.shape[1]))
                 gh0 = lax.slice(gh, (0, 0), (rp, 2))
                 root_hist = _feature_block_hist(rows, gh0, valid)
-                masked = jnp.where(valid[:, None], gh0, 0.0)
+                masked = jnp.where(valid[:, None],
+                                   gh0.astype(jnp.float32), 0.0)
                 if precision == "f64":
                     # exact root sums: the partials entering the root-sums
                     # allreduce must be order-independent (see _gsum_scalar)
@@ -803,6 +885,10 @@ class DeviceTreeLearner:
                 root_g, root_h = _masked_sums(indices, gh, root_count,
                                               root_padded,
                                               f64=precision == "f64")
+            if quant_on:
+                qs = qscale_box[0]
+                root_g = root_g * qs[0]
+                root_h = root_h * qs[1]
             root_hist = _gsum_hist(root_hist)
             # root grad/hess sums (data-parallel: the root-sums allreduce,
             # data_parallel_tree_learner.cpp:120-145)
@@ -1147,6 +1233,13 @@ class DeviceTreeLearner:
         if self.cfg.sequential_device_only:
             # forced splits / CEGB need the sequential fused loop
             return "sequential-only features (forced splits/CEGB)"
+        if (str(self.cfg.tpu_quant_hist).lower() == "on"
+                and getattr(self, "quant_bits", 0) > 0):
+            # explicit "on" means the user wants the quantized MXU hist
+            # path, which lives on the fused leaf-wise builder; under
+            # "auto" the aligned engine keeps priority and quantization
+            # simply stays inactive there
+            return "tpu_quant_hist=on (quantized hist rides the fused path)"
         from ..ops.aligned import aligned_available
         if not (bool(self.cfg.tpu_aligned_interpret) or aligned_available()):
             return "pallas kernels unavailable (no TPU, interpret off)"
@@ -1352,6 +1445,8 @@ class DeviceTreeLearner:
             lambda: self._make_build_fn(root_padded, False))
         args = [self.bins_dev, self.bins_T_dev, indices, grad, hess,
                 jnp.int32(root_count), self._fmask_arr(feature_mask)]
+        if self.quant_bits:
+            args.append(jnp.int32(self._next_qseq()))
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
         with obs_trace.span("learner.train", root=root_padded):
@@ -1376,6 +1471,8 @@ class DeviceTreeLearner:
             lambda: self._make_build_fn(root_padded, True))
         args = [self.bins_dev, self.bins_T_dev, grad, hess,
                 self._fmask_arr(feature_mask)]
+        if self.quant_bits:
+            args.append(jnp.int32(self._next_qseq()))
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
         with obs_trace.span("learner.train_fresh", root=root_padded):
@@ -1409,18 +1506,16 @@ class DeviceTreeLearner:
         def factory():
             build = self._make_build_fn(root_padded, True)
             n_rows = self.n
-            cegb_on = self._cegb_coupled_on
 
-            def step(score, bins, bins_T, scale, fmask, coupled_eff=None):
+            def step(score, bins, bins_T, scale, fmask, *opt):
                 # bins ride as runtime args (not closure constants) so
-                # the program is data-independent and registry-shareable
+                # the program is data-independent and registry-shareable;
+                # *opt forwards the (qseq?, coupled_eff?) tail untouched
                 compile_cache.note_trace()
                 gdev, hdev = objective.gradients_impl(score)
                 # nested jitted calls inline into this trace
-                bargs = [bins, bins_T, gdev[0], hdev[0], fmask]
-                if cegb_on:
-                    bargs.append(coupled_eff)
-                indices, rec = build(*bargs)
+                indices, rec = build(bins, bins_T, gdev[0], hdev[0],
+                                     fmask, *opt)
                 new_score = _partition_score_update(
                     score, jnp.int32(0), rec.leaf_begin,
                     rec.leaf_cnt_part, rec.leaf_value, indices,
@@ -1432,6 +1527,8 @@ class DeviceTreeLearner:
         fn = self._cached_program(key, factory)
         args = [score, self.bins_dev, self.bins_T_dev, jnp.float32(scale),
                 self._fmask_arr(feature_mask)]
+        if self.quant_bits:
+            args.append(jnp.int32(self._next_qseq()))
         if self._cegb_coupled_on:
             args.append(self._cegb_coupled_eff())
         out = fn(*args)
@@ -1536,7 +1633,9 @@ def _masked_sums(indices, gh, count, padded: int, f64: bool = False):
     pos = jnp.arange(padded, dtype=jnp.int32)
     valid = pos < count
     safe = jnp.where(valid, idx, 0)
-    masked = jnp.where(valid[:, None], gh[safe], 0.0)
+    # explicit f32: the quantized path passes int8/int16 gh rows (the
+    # caller rescales the sums by the pack scale afterwards)
+    masked = jnp.where(valid[:, None], gh[safe].astype(jnp.float32), 0.0)
     if f64:
         with jax.experimental.enable_x64():
             s = jnp.sum(masked.astype(jnp.float64), axis=0)

@@ -731,6 +731,18 @@ class GBDT:
         log.info(msg)
         log.event("train_path", path=path, gate_notes=notes,
                   rejected=why)
+        qb = int(getattr(self.learner, "quant_bits", 0) or 0)
+        # quantization lives on the fused leaf-wise builders; the aligned
+        # engine's packed records keep f32 gradient lanes, so under "auto"
+        # an aligned route means the oracle ran
+        active = qb > 0 and not path.startswith("aligned")
+        if active:
+            log.event("quant_hist", bits=qb,
+                      dtype="int8" if qb == 8 else "int16", reason=None)
+        elif str(self.cfg.tpu_quant_hist).lower() != "off":
+            reason = getattr(self.learner, "_quant_why", None) \
+                or f"{path} path keeps f32 payloads"
+            log.event("quant_hist", bits=0, dtype="f32", reason=reason)
 
     def _note_aligned_fallback(self, eng, why: str) -> None:
         """Count an aligned exact-replay fallback on the engine and

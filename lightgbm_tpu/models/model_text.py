@@ -38,7 +38,9 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
-    "tpu_dist_devices"})
+    "tpu_dist_devices",
+    # how the matrix was ingested does not change what it binned to
+    "tpu_stream_chunk_rows"})
 
 
 def _feature_infos(mappers) -> List[str]:

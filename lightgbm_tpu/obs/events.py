@@ -28,6 +28,12 @@ EVENTS: Dict[str, str] = {
                         "a finished training run",
     "compile_cache_miss": "persistent-compile-cache miss, with the "
                           "traced program signature (warm-up forensics)",
+    "quant_hist": "quantized-histogram path resolution: active bits "
+                  "and payload dtype, or why the f32 oracle ran "
+                  "instead",
+    "stream_ingest": "streaming out-of-core ingest finished: rows, "
+                     "chunk size, device-vs-host binning split, wall "
+                     "time",
     "telemetry": "per-round ledger record mirrored onto the event "
                  "channel by the telemetry callback",
     "train_path": "which training path a run took (fused / aligned / "

@@ -54,6 +54,12 @@ TERMS: Dict[str, str] = {
     "copy": "record-store copy move pass (no split, no hist)",
     "split_eval": "split finder over a changed-children histogram "
                   "batch",
+    "ingest": "streaming out-of-core ingest wall time (sample pass + "
+              "on-device chunk binning + HBM append) at dataset "
+              "construction",
+    "quant_pack": "stochastic-rounded gradient quantization pass of "
+                  "the quantized-histogram path (per-tree int8/int16 "
+                  "pack + scale)",
 }
 
 # _dispatch_device site string -> fenced term. Sites not listed fall

@@ -16,6 +16,7 @@ exact; payload precision is recovered with a hi/lo split (two bf16 matmuls
 from __future__ import annotations
 
 import functools
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +93,13 @@ def histogram_from_gathered_gh(bins_rows: jax.Array, gh: jax.Array,
     """Like `histogram_from_gathered` but with a pre-packed [P, 2]
     grad/hess payload — the caller gathers ONE wide array per leaf instead
     of two (random row gathers are the dominant cost on TPU)."""
+    if jnp.issubdtype(gh.dtype, jnp.integer):
+        # quantized payload (quantize_gh): the int8/int16 rows were
+        # gathered at quarter/half the f32 bytes; accumulation runs in
+        # f32 on the exact integer values (int16 |q| <= 32767 is exact
+        # under the bf16 hi/lo split, int8 in a single bf16 pass), and
+        # the caller rescales the finished histogram by the pack scale
+        gh = gh.astype(jnp.float32)
     if precision == "pallas":
         from .pallas_hist import pallas_histogram
         return pallas_histogram(bins_rows, gh, valid, max_bin)
@@ -157,6 +165,29 @@ def leaf_histogram(bins: jax.Array, indices: jax.Array, begin: jax.Array,
     h = hess[safe_idx]
     return histogram_from_gathered(rows, g, h, valid, max_bin, chunk,
                                    precision)
+
+
+@functools.partial(jax.jit, static_argnames=("bits",))
+def quantize_gh(gh: jax.Array, bits: int, key: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Stochastic-rounded per-column quantization of the [N, 2]
+    grad/hess payload (the TPU analogue of the reference's quantized
+    gradient work, `gradient_discretizer.cpp`): ``q = clip(floor(gh /
+    scale + u), -qmax, qmax)`` with ``u ~ U[0, 1)`` per element, so
+    ``E[q * scale] == gh`` — the rounding noise is unbiased and a fresh
+    key per tree keeps it independent across boosting rounds.
+
+    Returns ``(q int8/int16 [N, 2], scale f32 [2])``. Scales are the
+    per-column absmax over qmax (floored so all-zero hessians stay
+    finite); the caller multiplies finished histograms and leaf sums by
+    ``scale`` to return to f32 gradient units.
+    """
+    qmax = 127.0 if bits == 8 else 32767.0
+    absmax = jnp.max(jnp.abs(gh), axis=0)
+    scale = jnp.maximum(absmax / qmax, 1e-30).astype(jnp.float32)
+    u = jax.random.uniform(key, gh.shape, dtype=jnp.float32)
+    q = jnp.clip(jnp.floor(gh / scale + u), -qmax, qmax)
+    return q.astype(jnp.int8 if bits == 8 else jnp.int16), scale
 
 
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:

@@ -164,6 +164,11 @@ def pallas_histogram(bins_rows: jax.Array, gh: jax.Array, valid: jax.Array,
     p, f = bins_rows.shape
     if bins_rows.dtype != jnp.uint8:
         bins_rows = bins_rows.astype(jnp.uint8)
+    if jnp.issubdtype(gh.dtype, jnp.integer):
+        # quantized int8/int16 payload (ops/histogram.quantize_gh): the
+        # bandwidth win already happened at the per-leaf gather; the
+        # kernel accumulates the exact integer values in f32
+        gh = gh.astype(jnp.float32)
     g = jnp.where(valid, gh[:, 0], 0.0)
     h = jnp.where(valid, gh[:, 1], 0.0)
     cnt = valid.astype(jnp.float32)

@@ -62,6 +62,8 @@ RUNTIME_ONLY_PARAMS = frozenset({
     # finishing on a single chip
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
     "tpu_dist_devices",
+    # how the matrix was ingested does not change what it binned to
+    "tpu_stream_chunk_rows",
 })
 
 

@@ -235,6 +235,17 @@ def test_site_map_is_canonical():
     assert term_for_site("no.such.site", "binary") == "other"
 
 
+def test_ingest_and_quant_terms_catalogued():
+    """The streaming-ingest and quantized-hist planes publish through
+    the same closed term vocabulary as the train loop: bench records an
+    `ingest` term and the quant path a `quant_pack` term, so both must
+    be catalogued and schema-valid."""
+    for key in ("ingest", "quant_pack"):
+        assert key in TERMS and TERMS[key], key
+    assert validate_terms_ms({"ingest": 12.5, "quant_pack": None}) is None
+    assert validate_terms_ms({"ingest": "fast"}) is not None
+
+
 # ---------------------------------------------------------------------------
 # XLA cost attribution (CPU smoke)
 # ---------------------------------------------------------------------------

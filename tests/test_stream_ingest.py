@@ -10,6 +10,10 @@ import lightgbm_tpu as lgb
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import Dataset as CoreDataset
 from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.io.parser import (LibSVMParser, TSVParser, detect_format,
+                                    parse_dense)
+from lightgbm_tpu.io.stream import (DeviceBinner, pyarrow_available,
+                                    stream_matrix)
 
 
 def _write_csv(path, X, y, header=False, names=None):
@@ -170,3 +174,357 @@ def test_dataset_accepts_file_path(tmp_path):
     bst = lgb.Booster(params=params, train_set=ds)
     bst.update()
     assert np.isfinite(bst.predict(X[:10])).all()
+
+
+# ---------------------------------------------------------------------------
+# streaming out-of-core ingest: device-side binning, O(chunk) host memory
+# ---------------------------------------------------------------------------
+def _write_tsv(path, X, y):
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write("\t".join([f"{y[i]:g}"] +
+                              [f"{v:.17g}" for v in X[i]]) + "\n")
+
+
+def _nan_problem(n=1500, f=8, seed=5):
+    X, y = _problem(n=n, f=f, seed=seed)
+    X = X.astype(np.float64)
+    X[::7, 3] = np.nan          # exercise MISSING_NAN through the kernel
+    return X, y
+
+
+def test_streamed_file_model_byte_equal(tmp_path):
+    """A chunked file load (9 passes over a 1500-row file) must train a
+    model BYTE-EQUAL to the classic in-memory load: the sample draw, bin
+    boundaries, and binned values are all bitwise-shared."""
+    X, y = _nan_problem()
+    path = str(tmp_path / "train.tsv")
+    _write_tsv(path, X, y)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "num_iterations": 8}
+    mem = lgb.train(base, lgb.Dataset(path, params=base))
+    stream = dict(base, tpu_stream_chunk_rows=200)
+    st = lgb.train(stream, lgb.Dataset(path, params=stream))
+    assert st.model_to_string() == mem.model_to_string()
+
+
+def test_stream_matrix_model_byte_equal():
+    """In-memory matrices routed through stream_matrix (chunked upload +
+    device binning) also reproduce the classic model byte-for-byte."""
+    X, y = _nan_problem()
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "num_iterations": 8}
+    mem = lgb.train(base, lgb.Dataset(X, label=y, params=base))
+    stream = dict(base, tpu_stream_chunk_rows=256)
+    st = lgb.train(stream, lgb.Dataset(X, label=y, params=stream))
+    assert st.model_to_string() == mem.model_to_string()
+
+
+def test_streamed_validation_alignment(tmp_path):
+    """Validation files bin against the TRAIN dataset's mappers through
+    the streamed loader exactly as through the in-memory one."""
+    X, y = _nan_problem(n=1200)
+    Xv, yv = _nan_problem(n=400, seed=11)
+    tp, vp = str(tmp_path / "t.tsv"), str(tmp_path / "v.tsv")
+    _write_tsv(tp, X, y)
+    _write_tsv(vp, Xv, yv)
+    cfg = Config.from_params({"max_bin": 63, "verbosity": -1})
+    train = DatasetLoader(cfg).load_from_file(tp)
+    valid = DatasetLoader(cfg).load_from_file_align_with_other_dataset(
+        vp, train)
+    cfg_s = Config.from_params({"max_bin": 63, "verbosity": -1,
+                                "tpu_stream_chunk_rows": 300})
+    train_s = DatasetLoader(cfg_s).load_from_file(tp)
+    valid_s = DatasetLoader(
+        cfg_s).load_from_file_align_with_other_dataset(vp, train_s)
+    np.testing.assert_array_equal(train_s.bins, train.bins)
+    np.testing.assert_array_equal(valid_s.bins, valid.bins)
+    np.testing.assert_allclose(valid_s.metadata.label, valid.metadata.label)
+
+
+def test_streamed_load_is_o_chunk(tmp_path):
+    """The streamed loader never materializes more than one chunk of raw
+    lines (file is 8 chunks long) and records its ingest telemetry."""
+    X, y = _nan_problem(n=1600)
+    path = str(tmp_path / "t.tsv")
+    _write_tsv(path, X, y)
+    loader = DatasetLoader(Config.from_params(
+        {"verbosity": -1, "tpu_stream_chunk_rows": 200}))
+    ds = loader.load_from_file(path)
+    assert loader._max_chunk_rows <= 200
+    assert ds.num_data == 1600
+    assert ds._ingest_stats["rows"] == 1600
+    assert ds._ingest_stats["chunk_rows"] == 200
+    assert ds._ingest_ms >= 0.0
+
+
+def test_stream_matrix_peak_host_memory_o_chunk():
+    """stream_matrix on a matrix 8x the chunk size must keep NEW host
+    allocations well under one full f64 copy of the data — the point of
+    out-of-core ingest. (tracemalloc tracks numpy buffers; the input
+    matrix itself predates the trace.)"""
+    import tracemalloc
+
+    X, y = _problem(n=8000, f=16, seed=9)
+    cfg = Config.from_params({"verbosity": -1,
+                              "tpu_stream_chunk_rows": 1000,
+                              "bin_construct_sample_cnt": 1000})
+    full_f64 = X.shape[0] * X.shape[1] * 8
+    # warm the jit caches so compilation scratch doesn't pollute the peak
+    stream_matrix(X[:2000], label=y[:2000], config=cfg)
+    tracemalloc.start()
+    ds = stream_matrix(X, label=y, config=cfg)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert ds.num_data == 8000
+    assert peak < full_f64, (peak, full_f64)
+
+
+def test_device_binner_bitwise_vs_host_oracle():
+    """The jitted searchsorted kernel must agree BITWISE with the host
+    BinMapper::ValueToBin loop on boundary-adjacent values, NaN, and
+    +/-inf — the f64-compare discipline the x64 ctx exists for."""
+    X, y = _nan_problem(n=800)
+    cfg = Config.from_params({"max_bin": 63, "verbosity": -1})
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    binner = DeviceBinner(ds, chunk_rows=64)
+    assert binner.num_used > 0
+    rng = np.random.default_rng(2)
+    probe = rng.standard_normal((64, X.shape[1]))
+    # plant adversarial values: exact boundaries and their f64 neighbors
+    m0 = ds.mappers[int(binner.used[0])]
+    ub = np.asarray(m0.bin_upper_bound, np.float64)
+    edges = ub[np.isfinite(ub)][:20]
+    probe[:len(edges), 0] = edges
+    probe[:len(edges), 1] = np.nextafter(edges, -np.inf)
+    probe[:len(edges), 2] = np.nextafter(edges, np.inf)
+    probe[40:44, 0] = [np.nan, np.inf, -np.inf, 0.0]
+    dev = np.asarray(binner.bin_chunk(probe))
+    host = np.stack([ds.mappers[j].values_to_bins(probe[:, j])
+                     for j in binner.used], axis=1)
+    np.testing.assert_array_equal(dev, host.astype(dev.dtype))
+
+
+def test_streamed_striped_sidecar_weights(tmp_path):
+    """Distributed striping through the STREAMED loader gathers sidecar
+    weights by global row index (same contract as two_round)."""
+    X, y = _problem(n=400)
+    path = str(tmp_path / "t.tsv")
+    _write_tsv(path, X, y)
+    w = np.arange(400, dtype=np.float64) + 1.0
+    with open(path + ".weight", "w") as f:
+        f.write("\n".join(f"{v:g}" for v in w))
+    loader = DatasetLoader(Config.from_params({"verbosity": -1}))
+    ds = loader._load_streamed(path, rank=1, num_machines=2,
+                               chunk_lines=64)
+    assert ds.num_data == 200
+    np.testing.assert_allclose(ds.metadata.weight, w[1::2])
+
+
+def test_streamed_libsvm_ragged(tmp_path):
+    """LibSVM chunks carry different max column indices; the streamed
+    loader's count pass fixes the GLOBAL width before binning and the
+    result matches the one-shot load bitwise."""
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "data.svm")
+    n, f = 900, 10
+    rows = []
+    y = np.zeros(n)
+    for i in range(n):
+        y[i] = float(rng.integers(0, 2))
+        cols = sorted(rng.choice(f if i > n - 50 else 4, size=3,
+                                 replace=False))
+        toks = [f"{y[i]:g}"]
+        for c in cols:
+            toks.append(f"{c}:{float(rng.standard_normal()):.6g}")
+        rows.append(" ".join(toks))
+    with open(path, "w") as fh:
+        fh.write("\n".join(rows))
+    loader = DatasetLoader(Config.from_params(
+        {"verbosity": -1, "tpu_stream_chunk_rows": 100}))
+    ds = loader.load_from_file(path)
+    one = DatasetLoader(Config.from_params(
+        {"verbosity": -1})).load_from_file(path)
+    assert ds.num_total_features == one.num_total_features
+    np.testing.assert_array_equal(ds.bins, one.bins)
+    np.testing.assert_allclose(ds.metadata.label, one.metadata.label)
+
+
+@pytest.mark.skipif(not pyarrow_available(), reason="pyarrow not installed")
+def test_parquet_columnar_streamed(tmp_path):
+    """Parquet files route through the columnar front door and bin
+    identically to the same values loaded as an in-memory matrix."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    X, y = _nan_problem(n=700, f=5)
+    path = str(tmp_path / "train.parquet")
+    cols = {"label": y}
+    for j in range(X.shape[1]):
+        cols[f"f{j}"] = X[:, j]
+    pq.write_table(pa.table(cols), path)
+    cfg = Config.from_params({"verbosity": -1,
+                              "tpu_stream_chunk_rows": 128})
+    ds = DatasetLoader(cfg).load_from_file(path)
+    mem = CoreDataset.from_matrix(
+        X, label=y, config=Config.from_params({"verbosity": -1}))
+    assert ds.num_data == 700
+    np.testing.assert_array_equal(ds.bins, mem.bins)
+    np.testing.assert_allclose(ds.metadata.label, y)
+    assert ds._ingest_stats["rows"] == 700
+
+
+# ---------------------------------------------------------------------------
+# parser chunk-boundary edge cases
+# ---------------------------------------------------------------------------
+def test_iter_line_chunks_boundary_and_no_trailing_newline(tmp_path):
+    """Chunk boundaries fall between records, never inside one, and a
+    final line without a trailing newline still comes through whole."""
+    path = str(tmp_path / "t.tsv")
+    rows = [f"{i % 2}\t{i + 0.5:.6g}\t{-i - 0.25:.6g}" for i in range(10)]
+    with open(path, "w") as f:
+        f.write("\n".join(rows))    # NO trailing newline
+    loader = DatasetLoader(Config.from_params({"verbosity": -1}))
+    chunks = list(loader._iter_line_chunks(path, 3))
+    assert [len(c) for c in chunks] == [3, 3, 3, 1]
+    flat = [ln.rstrip("\n") for c in chunks for ln in c]
+    assert flat == rows
+    # every yielded line is a complete record: 3 fields each
+    assert detect_format(flat) == "tsv"
+    labs, feats = parse_dense(flat, TSVParser(0))
+    assert feats.shape == (10, 2)
+    np.testing.assert_allclose(labs, [i % 2 for i in range(10)])
+
+
+def test_libsvm_out_of_order_indices():
+    """Reference parser tolerates unsorted feature indices per row."""
+    p = LibSVMParser(0)
+    lab, pairs = p.parse_one_line("1 3:1.5 0:2.25 7:-1.75")
+    assert lab == 1.0
+    assert dict(pairs) == {3: 1.5, 0: 2.25, 7: -1.75}
+    assert p.num_features("1 3:1.5 0:2.25 7:-1.75") == 8
+    labs, feats = parse_dense(["1 3:1.5 0:2.25 7:-1.75",
+                               "0 5:4 1:0.5"], p)
+    assert feats.shape == (2, 8)
+    assert feats[0, 3] == 1.5 and feats[0, 0] == 2.25
+    assert feats[1, 5] == 4.0 and feats[1, 1] == 0.5
+    assert feats[0, 7] == -1.75 and feats[1, 7] == 0.0
+
+
+def test_detect_format_on_single_line_sample():
+    """Format sniffing must work on a one-line sample — the streamed
+    loader's first chunk can be a single record."""
+    assert detect_format(["1\t2.5\t3.75"]) == "tsv"
+    assert detect_format(["1,2.5,3.75"]) == "csv"
+    assert detect_format(["1 0:1.5 3:2.5"]) == "libsvm"
+    with pytest.raises(ValueError):
+        detect_format(["justoneword"])
+
+
+# ---------------------------------------------------------------------------
+# quantized gradient/histogram accumulation (tpu_quant_hist)
+# ---------------------------------------------------------------------------
+def _auc(labels, preds):
+    order = np.argsort(preds, kind="mergesort")
+    ranks = np.empty(len(preds))
+    ranks[order] = np.arange(1, len(preds) + 1)
+    pos = labels > 0
+    npos, nneg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def _strip_params(model_str):
+    out, skip = [], False
+    for ln in model_str.splitlines():
+        if ln == "parameters:":
+            skip = True
+        if not skip:
+            out.append(ln)
+        if ln == "end of parameters":
+            skip = False
+    return "\n".join(out)
+
+
+def test_quantize_gh_bounds_and_unbiasedness():
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import quantize_gh
+
+    rng = np.random.default_rng(4)
+    gh = jnp.asarray(
+        np.column_stack([rng.standard_normal(512) * 3.0,
+                         rng.random(512) * 0.25]).astype(np.float32))
+    for bits, qmax in ((8, 127), (16, 32767)):
+        q, scale = quantize_gh(gh, bits, jax.random.PRNGKey(0))
+        q = np.asarray(q)
+        scale = np.asarray(scale)
+        assert q.dtype == (np.int8 if bits == 8 else np.int16)
+        assert np.all(np.abs(q.astype(np.int64)) <= qmax)
+        assert np.all(scale > 0)
+        # one stochastic draw lands within one quantum of the truth
+        err = np.abs(q.astype(np.float64) * scale - np.asarray(gh))
+        assert np.all(err <= scale * (1 + 1e-6))
+    # averaging many independent keys converges on the true payload:
+    # the rounding noise is unbiased
+    acc = np.zeros(gh.shape)
+    keys = 64
+    for s in range(keys):
+        q, scale = quantize_gh(gh, 16, jax.random.PRNGKey(s))
+        acc += np.asarray(q).astype(np.float64) * np.asarray(scale)
+    np.testing.assert_allclose(acc / keys, np.asarray(gh),
+                               atol=float(scale.max()) * 0.6)
+
+
+def test_quant_off_trees_identical_to_auto_ineligible():
+    """`off` must be bitwise the f32 path: on the CPU backend `auto`
+    resolves to ineligible (oracle ran), so the two model's TREES are
+    identical — only the recorded param value differs."""
+    X, y = _problem(n=1200, f=8)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    off = lgb.train(dict(base, tpu_quant_hist="off"),
+                    lgb.Dataset(X, label=y,
+                                params=dict(base, tpu_quant_hist="off")),
+                    num_boost_round=5)
+    auto = lgb.train(dict(base, tpu_quant_hist="auto"),
+                     lgb.Dataset(X, label=y,
+                                 params=dict(base, tpu_quant_hist="auto")),
+                     num_boost_round=5)
+    assert _strip_params(off.model_to_string()) == \
+        _strip_params(auto.model_to_string())
+
+
+@pytest.mark.parametrize("bits", [16, 8])
+def test_quant_on_auc_within_tolerance(bits):
+    """Forced-on quantization (interpret-grade on CPU) emits the
+    quant_hist event and stays within AUC tolerance of the f32 oracle:
+    1e-3 for int16 (the acceptance bound), looser for int8."""
+    from lightgbm_tpu.utils import log
+    from lightgbm_tpu.utils.log import parse_event
+
+    X, y = _problem(n=2000, f=10, seed=7)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+    def train(extra, capture=False):
+        params = dict(base, **extra)
+        lines = []
+        if capture:
+            params["verbosity"] = 2
+            log.register_callback(lines.append)
+        try:
+            bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                            num_boost_round=5)
+        finally:
+            if capture:
+                log.register_callback(None)
+        events = [e for e in map(parse_event, lines) if e]
+        return _auc(y, bst.predict(X)), events
+
+    auc_off, _ = train({"tpu_quant_hist": "off"})
+    auc_on, events = train({"tpu_quant_hist": "on",
+                            "tpu_quant_hist_bits": bits}, capture=True)
+    qh = [e for e in events if e["event"] == "quant_hist"]
+    assert qh and qh[0]["bits"] == bits, qh
+    assert qh[0]["dtype"] == ("int8" if bits == 8 else "int16"), qh[0]
+    tol = 1e-3 if bits == 16 else 2e-2
+    assert abs(auc_on - auc_off) < tol, (auc_on, auc_off)
