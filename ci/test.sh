@@ -233,12 +233,14 @@ from tools.bench_serve_traffic import run
 
 sdir = os.environ["LGBT_SERVE_SMOKE_DIR"]
 led_path = os.path.join(sdir, "serve-ledger.jsonl")
+trace_dir = os.path.join(sdir, "reqtrace")
 ledger = obs_ledger.RoundLedger(led_path, {"smoke": "serving"})
 # two resident models; the hot-swap leg fires threaded requests on m0
-# while a retrained version swaps in
+# while a retrained version swaps in; request tracing is on at
+# sample=1.0 so EVERY request must land exactly one trace row
 res = run(models=2, qps_list=(25, 100), open_secs=1.0, closed_secs=1.0,
           clients=16, train_rows=1500, train_rounds=20, ledger=ledger,
-          verbose=True)
+          verbose=True, trace_dir=trace_dir, trace_sample=1.0)
 ledger.close()
 
 # zero failed requests anywhere — closed loops, QPS sweep, swap leg
@@ -272,13 +274,34 @@ for k in ("serve_direct_rows_s", "serve_coalesced_rows_s",
 assert res["coalesced_vs_direct"] > 1.0, res["coalesced_vs_direct"]
 assert res["serve_swaps"] == 1
 
+# request tracing: N threaded requests through the live hot swap must
+# yield exactly N trace rows — no losses, no duplicates
+import glob
+tr = res["serve_trace"]
+assert tr["started"] == tr["finished"] == res["serve_requests"], tr
+trace_files = glob.glob(os.path.join(trace_dir, "reqtrace-*.jsonl"))
+assert len(trace_files) == 1, trace_files
+rows = [json.loads(ln) for ln in open(trace_files[0])]
+reqs = [r for r in rows if r["kind"] == "request"]
+assert len(reqs) == res["serve_requests"], \
+    (len(reqs), res["serve_requests"])
+ids = [r["trace_id"] for r in reqs]
+assert len(set(ids)) == len(ids), "duplicate trace rows"
+assert all(r["flush_reason"] in ("full", "deadline") for r in reqs)
+assert all(r["queue_wait_ms"] is not None and r["queue_wait_ms"] >= 0
+           for r in reqs)
+assert all(r["status"] == "ok" for r in reqs)
+# the swap shows up as a marker row interleaved in the same stream
+assert any(r["kind"] == "marker" and r["marker"] == "serve_swap"
+           for r in rows)
+
 out_path = os.path.join(sdir, "serve_traffic.json")
 with open(out_path, "w") as fh:
     json.dump(res, fh, sort_keys=True)
 print(f"serving smoke: ok (coalesced/direct="
       f"{res['coalesced_vs_direct']}x, "
       f"{res['serve_hot_swap']['requests_ok']} requests through the "
-      f"swap, record at {out_path})")
+      f"swap, {len(reqs)} trace rows exactly-once, record at {out_path})")
 EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
     echo "serving artifacts kept under $SERVE_DIR for artifact upload"
@@ -315,10 +338,16 @@ s.close()
 EOF
 )
 # serve the model, score rows through the coalescer, then hold the
-# process up so the scrape sees a LIVE endpoint mid-serve
+# process up so the scrape sees a LIVE endpoint mid-serve. Request
+# tracing is on with sample=0 and a deliberately tiny SLO: every
+# request breaches, so tail sampling alone must keep 100% of them
+# (500 rows / 64-row requests = 8 requests, all slow-injected).
 python -m lightgbm_tpu task=serve "input_model=m=$MET_DIR/model.txt" \
     "data=$MET_DIR/rows.tsv" "output_result=$MET_DIR/preds.txt" \
     "tpu_serve_metrics_port=$MET_PORT" tpu_serve_hold_s=60 \
+    tpu_serve_trace=true "tpu_serve_trace_dir=$MET_DIR/reqtrace" \
+    tpu_serve_trace_sample=0 tpu_serve_slo_ms=0.0001 \
+    tpu_serve_max_batch_rows=64 \
     verbosity=-1 > "$MET_DIR/serve.log" 2>&1 &
 SERVE_PID=$!
 for _ in $(seq 1 240); do
@@ -326,10 +355,12 @@ for _ in $(seq 1 240); do
     sleep 0.25
 done
 LGBT_MET_DIR="$MET_DIR" LGBT_MET_PORT="$MET_PORT" python - <<'EOF'
+import glob
 import json
 import os
 import urllib.request
 
+mdir = os.environ["LGBT_MET_DIR"]
 port = os.environ["LGBT_MET_PORT"]
 base = f"http://127.0.0.1:{port}"
 with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
@@ -375,8 +406,49 @@ assert doc["memory"]["claimed_bytes"] > 0
 assert "hbm_unattributed_bytes" in doc["memory"]
 hist = doc["metrics"]["histograms"]['serve_request_latency_ms{model="m"}']
 assert hist["count"] > 0 and hist["p99_ms"] is not None
-print(f"metrics scrape smoke: ok ({int(series('serve_requests_total'))} "
+
+# -- request tracing: /debug/requests + tail sampling + exemplars ------
+n_req = int(series("serve_requests_total"))
+assert n_req == 8, n_req          # 500 rows / 64-row requests
+with urllib.request.urlopen(base + "/debug/requests", timeout=10) as resp:
+    dbg = json.load(resp)
+assert dbg["enabled"] is True
+assert dbg["totals"]["started"] == dbg["totals"]["finished"] == n_req
+ring_reqs = [r for r in dbg["recent"] if r["kind"] == "request"]
+ring_ids = [r["trace_id"] for r in ring_reqs]
+# every submitted request appears exactly once in the live ring
+assert len(ring_ids) == len(set(ring_ids)) == n_req, ring_ids
+assert dbg["slow"], "slow-request table empty"
+# the tiny SLO slow-injected every request: tail sampling at sample=0
+# must keep 100% of them in the JSONL, flush reason + queue wait set
+trace_files = glob.glob(os.path.join(mdir, "reqtrace",
+                                     "reqtrace-*.jsonl"))
+assert len(trace_files) == 1, trace_files
+jrows = [json.loads(ln) for ln in open(trace_files[0])]
+jreqs = [r for r in jrows if r["kind"] == "request"]
+assert len(jreqs) == n_req, (len(jreqs), n_req)
+assert all(r["slo_breach"] for r in jreqs)
+assert all(r["flush_reason"] in ("full", "deadline") for r in jreqs)
+assert all(r["queue_wait_ms"] is not None for r in jreqs)
+assert set(r["trace_id"] for r in jreqs) == set(ring_ids)
+# SLO instruments: all-breaching traffic pins the burn gauge at 1.0
+assert series('serve_slo_burn_rate{model="m"}') == 1.0
+assert series('serve_slo_breaches_total{model="m"}') == n_req
+assert series('serve_requests_completed_total{model="m",status="ok"}') \
+    == n_req
+# p99 histogram exemplars resolve to trace IDs present in the JSONL
+assert " # {trace_id=" in text, "no exemplar on any _bucket line"
+exemplars = hist.get("exemplars") or {}
+assert exemplars, "latency histogram carries no exemplars"
+jids = {r["trace_id"] for r in jreqs}
+for le, ex in exemplars.items():
+    assert ex["trace_id"] in jids, (le, ex)
+with open(os.path.join(mdir, "metrics_snapshot.json"), "w") as fh:
+    json.dump(doc, fh, sort_keys=True)
+print(f"metrics scrape smoke: ok ({n_req} "
       f"requests, p50={p50:.3g}ms p99={p99:.3g}ms, "
+      f"{len(jreqs)} tail-kept trace rows, "
+      f"{len(exemplars)} exemplars resolved, "
       f"claimed={int(series('hbm_claimed_total_bytes'))}B)")
 EOF
 kill -INT "$SERVE_PID" 2>/dev/null || true
@@ -389,6 +461,29 @@ if [ "$SERVE_RC" -ne 0 ]; then
     cat "$MET_DIR/serve.log" >&2
     exit 1
 fi
+
+# trace_report merges the request JSONL + metrics snapshot into a
+# ranked slow-request report (exit 0 with data; 2 would fail the gate)
+python tools/trace_report.py --reqtrace "$MET_DIR/reqtrace" \
+    --metrics "$MET_DIR/metrics_snapshot.json" \
+    --json "$MET_DIR/trace_report.json"
+LGBT_MET_DIR="$MET_DIR" python - <<'EOF'
+import json
+import os
+
+rep = json.load(open(os.path.join(os.environ["LGBT_MET_DIR"],
+                                  "trace_report.json")))
+assert rep["schema"] == 1
+assert rep["totals"]["requests"] == 8, rep["totals"]
+assert rep["models"] and rep["models"][0]["model"] == "m"
+slow = rep["slow_requests"]
+assert slow, "report has no ranked slow requests"
+lat = [r["total_ms"] for r in slow]
+assert lat == sorted(lat, reverse=True), "slow requests not ranked"
+assert all(e["resolved"] for e in rep["exemplars"]), rep["exemplars"]
+print(f"trace report: ok ({len(slow)} ranked, "
+      f"{len(rep['exemplars'])} exemplars resolved)")
+EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
     echo "metrics artifacts kept under $MET_DIR for artifact upload"
 else

@@ -304,6 +304,9 @@ class Application:
             if svc.exporter is not None:
                 print(f"Metrics: {svc.exporter.url}/metrics "
                       f"(Prometheus) and /metrics.json", flush=True)
+                if svc.tracer is not None:
+                    print(f"Request traces: {svc.exporter.url}"
+                          f"/debug/requests", flush=True)
             if cfg.tpu_serve_hold_s > 0:
                 # scrape/hot-swap window: hold the service up, exit
                 # early and cleanly on Ctrl-C / SIGTERM

@@ -596,6 +596,41 @@ class Config:
     # scrapers hit the /metrics exporter and checkpoint watchers may
     # hot-swap. SIGINT/SIGTERM end the hold early and exit cleanly
     tpu_serve_hold_s: float = 0.0
+    # request-scoped serving tracer (obs/reqtrace.py): every
+    # Coalescer.submit mints a trace ID whose span records queue-wait,
+    # batch id, flush reason (full vs deadline), batch fill ratio,
+    # engine dispatch time share and total latency — even when the
+    # batched engine call raises. Records land in a fixed in-memory ring
+    # (served at the exporter's /debug/requests) and a tail-sampled
+    # JSONL stream, and feed per-model SLO burn-rate gauges. Off by
+    # default and free when off: the coalescer hot path pays one is-None
+    # branch and zero device fences. Runtime-only: excluded from model
+    # text and checkpoint signatures
+    tpu_serve_trace: bool = False
+    # directory for the request-trace JSONL stream
+    # (reqtrace-<pid>.jsonl: one header line, then one row per KEPT
+    # request, flushed per line so a killed host keeps everything so
+    # far). Empty: ring buffer + /debug/requests only, no file
+    tpu_serve_trace_dir: str = ""
+    # head-sampling rate in [0, 1] for the request-trace JSONL stream: a
+    # non-breaching request is kept when a deterministic hash of its
+    # trace ID falls under this rate (no RNG — the same traffic keeps
+    # the same rows on every run). Requests breaching tpu_serve_slo_ms
+    # and errored requests are ALWAYS kept, so 0.0 is pure tail
+    # sampling: SLO breachers and failures only
+    tpu_serve_trace_sample: float = 0.0
+    # request rows retained in the in-memory trace ring behind the
+    # exporter's /debug/requests endpoint (oldest overwritten first);
+    # registry load/swap/evict markers share the same ring
+    tpu_serve_trace_ring: int = 512
+    # per-request latency SLO in milliseconds for the serving plane. A
+    # request whose submit-to-result latency exceeds it is a breach:
+    # always kept in the trace stream, counted in
+    # serve_slo_breaches_total, surfaced as a rate-limited
+    # serve_request_slow event, and folded into the rolling per-model
+    # serve_slo_burn_rate gauge — the admission/load-shedding signal.
+    # 0 disables SLO classification (nothing breaches)
+    tpu_serve_slo_ms: float = 0.0
     # runtime lock-discipline assertions (utils/locks.py): install a
     # checking __setattr__ on the serving/metrics classes whose shared
     # state is declared `# guarded-by:` — a guarded attribute rebound

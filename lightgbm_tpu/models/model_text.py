@@ -35,7 +35,9 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_hbm_budget_mb", "tpu_serve_max_batch_wait_ms",
     "tpu_serve_max_batch_rows", "tpu_serve_watch_interval_s",
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
-    "tpu_serve_hold_s", "tpu_profile", "tpu_profile_every",
+    "tpu_serve_hold_s", "tpu_serve_trace", "tpu_serve_trace_dir",
+    "tpu_serve_trace_sample", "tpu_serve_trace_ring", "tpu_serve_slo_ms",
+    "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
     "tpu_dist_devices",

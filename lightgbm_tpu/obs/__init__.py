@@ -9,7 +9,7 @@ branch. Enable with the `tpu_trace` / `tpu_trace_dir` params (both enter
 than silently reusing a differently-fenced program).
 """
 from . import (bench_record, devicetime, ledger, memory,  # noqa: F401
-               metrics, profiler, terms, trace)
+               metrics, profiler, reqtrace, terms, trace)
 
 __all__ = ["bench_record", "devicetime", "ledger", "memory", "metrics",
-           "profiler", "terms", "trace"]
+           "profiler", "reqtrace", "terms", "trace"]

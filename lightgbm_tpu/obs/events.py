@@ -51,7 +51,14 @@ EVENTS: Dict[str, str] = {
     "serve_load": "registry loaded (or replaced) a named model",
     "serve_over_budget": "a single protected entry alone exceeds the "
                          "HBM budget (load proceeds with a warning)",
+    "serve_request_slow": "a coalesced request breached tpu_serve_slo_ms "
+                          "(rate-limited pointer; the full span is in "
+                          "the request-trace ring/JSONL)",
+    "serve_slo_burn": "a model's rolling SLO burn rate crossed the high "
+                      "watermark — the load-shedding trip signal",
     "serve_swap": "registry hot-swapped a named model to a new version",
+    "serve_trace_dump": "request tracer closed: kept-row / breach / "
+                        "error totals and the JSONL path",
     "serve_watch_bad_model": "checkpoint watcher skipped a torn/invalid "
                              "model version (retried next tick)",
     "serve_watch_error": "checkpoint watcher poll raised; the thread "

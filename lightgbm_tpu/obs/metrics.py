@@ -150,10 +150,16 @@ class Histogram:
     interpolates linearly inside the covering bucket (the standard
     Prometheus `histogram_quantile` estimate), so p50/p99 are available
     host-side without retaining observations.
+
+    Exemplars: `observe(ms, exemplar="r...-...")` stamps the bucket the
+    observation lands in with that trace ID (last write wins per
+    bucket), so "what is p99" comes with "here is a request AT p99" —
+    the join key into the request-trace ring/JSONL (obs/reqtrace.py).
+    Cost without an exemplar is one extra is-None check.
     """
 
     __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_count",
-                 "_lock")
+                 "_exemplars", "_lock")
 
     def __init__(self, name: str, help: str = "",
                  bounds: Sequence[float] = BUCKET_BOUNDS_MS) -> None:
@@ -164,9 +170,11 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)  # guarded-by: _lock
         self._sum = 0.0                               # guarded-by: _lock
         self._count = 0                               # guarded-by: _lock
+        # bucket index -> (exemplar_id, value_ms), last write wins
+        self._exemplars: Dict[int, Tuple[str, float]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
-    def observe(self, ms: float) -> None:
+    def observe(self, ms: float, exemplar: Optional[str] = None) -> None:
         ms = float(ms)
         import bisect
         i = bisect.bisect_left(self.bounds, ms)
@@ -174,6 +182,20 @@ class Histogram:
             self._counts[i] += 1
             self._sum += ms
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), ms)
+
+    def _le_key(self, i: int) -> str:
+        """JSON bucket key for bucket index `i` — same convention as
+        snapshot()'s cumulative-bucket keys."""
+        return "+Inf" if i >= len(self.bounds) else repr(self.bounds[i])
+
+    def exemplars(self) -> Dict[str, Dict[str, Any]]:
+        """{le_key: {trace_id, value_ms}} for buckets with an exemplar."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        return {self._le_key(i): {"trace_id": tid, "value_ms": round(v, 4)}
+                for i, (tid, v) in items}
 
     @property
     def count(self) -> int:
@@ -332,6 +354,9 @@ class MetricsRegistry:
                                      else repr(b)): c
                                     for b, c in inst.cumulative()},
                     }
+                    ex = inst.exemplars()
+                    if ex:
+                        hists[key]["exemplars"] = ex
         return {"schema": SCHEMA_VERSION, "counters": counters,
                 "gauges": gauges, "histograms": hists}
 
@@ -354,10 +379,19 @@ class MetricsRegistry:
                     lines.append(f"{name}{suffix} {v:g}")
                     continue
                 base = suffix[1:-1] if suffix else ""
-                for b, c in inst.cumulative():
+                ex = inst.exemplars()
+                for i, (b, c) in enumerate(inst.cumulative()):
                     le = "+Inf" if b == float("inf") else f"{b:g}"
                     joined = ",".join(x for x in (base, f'le="{le}"') if x)
-                    lines.append(f"{name}_bucket{{{joined}}} {c}")
+                    line = f"{name}_bucket{{{joined}}} {c}"
+                    # OpenMetrics exemplar suffix, appended ONLY to
+                    # _bucket lines (non-bucket series stay parseable
+                    # as `last token is the value`)
+                    e = ex.get(inst._le_key(i))
+                    if e is not None:
+                        line += (f' # {{trace_id="{e["trace_id"]}"}} '
+                                 f'{e["value_ms"]:g}')
+                    lines.append(line)
                 lines.append(f"{name}_sum{suffix} {inst.sum:g}")
                 lines.append(f"{name}_count{suffix} {inst.count}")
                 for q, tag in ((0.50, "p50"), (0.99, "p99")):
@@ -450,6 +484,20 @@ def serving_instruments() -> Any:
     ns.latency = r.histogram(
         "serve_request_latency_ms",
         "submit-to-result latency per request (ms)",
+        labelnames=("model",))
+    ns.completed = r.counter(
+        "serve_requests_completed_total",
+        "requests completed by outcome — ok + error sums to "
+        "serve_requests_total once the queue drains",
+        labelnames=("model", "status"))
+    ns.slo_breaches = r.counter(
+        "serve_slo_breaches_total",
+        "requests whose total latency breached tpu_serve_slo_ms",
+        labelnames=("model",))
+    ns.slo_burn = r.gauge(
+        "serve_slo_burn_rate",
+        "rolling fraction of SLO-breaching/errored requests over the "
+        "last 256 outcomes (obs/reqtrace.py burn window)",
         labelnames=("model",))
     ns.loads = r.counter(
         "serve_model_loads_total", "registry model loads")

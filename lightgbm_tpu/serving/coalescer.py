@@ -41,13 +41,14 @@ __all__ = ["RequestCoalescer"]
 
 
 class _Req:
-    __slots__ = ("X", "rows", "t_submit", "future")
+    __slots__ = ("X", "rows", "t_submit", "future", "span")
 
     def __init__(self, X: np.ndarray) -> None:
         self.X = X
         self.rows = int(X.shape[0])
         self.t_submit = time.perf_counter()
         self.future: Future = Future()
+        self.span = None        # TraceSpan when request tracing is on
 
 
 @locks.guarded
@@ -55,10 +56,13 @@ class RequestCoalescer:
     """SLO-aware batcher in front of a `ModelRegistry`."""
 
     def __init__(self, registry, max_batch_wait_ms: float = 2.0,
-                 max_batch_rows: int = 8192) -> None:
+                 max_batch_rows: int = 8192, tracer=None) -> None:
         self.registry = registry
         self.wait_s = max(float(max_batch_wait_ms), 0.0) / 1e3
         self.max_batch_rows = max(int(max_batch_rows), 1)
+        # request tracer (obs/reqtrace.py): None when tpu_serve_trace is
+        # off — the hot path then pays one is-None branch, nothing else
+        self._tracer = tracer
         self._cv = threading.Condition()
         self._queues: Dict[str, deque] = {}         # guarded-by: _cv
         self._closed = False                        # guarded-by: _cv
@@ -90,6 +94,12 @@ class RequestCoalescer:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
             self.requests += 1
+            # mint the span under _cv (after the closed check) so the
+            # flusher can never observe a queued request without one,
+            # and a closed-coalescer raise never leaks a started span
+            if self._tracer is not None:
+                req.span = self._tracer.start(model, req.rows,
+                                              req.t_submit)
             self._queues.setdefault(model, deque()).append(req)
             self._cv.notify()
         if self._metrics is not None:
@@ -104,8 +114,20 @@ class RequestCoalescer:
                 return
             self._closed = True
             if not drain:
+                t_now = time.perf_counter()
                 for q in self._queues.values():
                     for req in q:
+                        if req.span is not None:
+                            # started == finished even for requests the
+                            # shutdown killed — their trace row says so
+                            self._tracer.finish(
+                                req.span,
+                                queue_wait_ms=(t_now - req.t_submit) * 1e3,
+                                batch_id=None, flush_reason="closed",
+                                batch_rows=None, batch_requests=None,
+                                fill_ratio=None, dispatch_ms=None,
+                                total_ms=(t_now - req.t_submit) * 1e3,
+                                status="error", error="coalescer closed")
                         req.future.set_exception(
                             RuntimeError("coalescer closed"))
                     q.clear()
@@ -179,21 +201,38 @@ class RequestCoalescer:
 
     def _flush(self, model: str, batch: List[_Req], reason: str) -> None:
         rows = sum(r.rows for r in batch)
+        tr = self._tracer
+        batch_id = tr.next_batch_id() if tr is not None else None
+        t_start = time.perf_counter()   # flusher picked the batch up
         try:
             entry = self.registry.acquire(model)
             X = (batch[0].X if len(batch) == 1
                  else np.concatenate([r.X for r in batch], axis=0))
             eng = entry.engine
+            t_d0 = time.perf_counter()
             with obs_trace.span("serving.flush", model=model, rows=rows,
                                 requests=len(batch), reason=reason):
                 margins, _ = eng.predict(X)
+            t_d1 = time.perf_counter()
             padded = sum(eng._bucket(min(rows - lo, eng.chunk_rows))
                          for lo in range(0, max(rows, 1), eng.chunk_rows))
             entry.buckets.add(eng._bucket(min(rows, eng.chunk_rows)))
             if entry.num_class <= 1:
                 margins = margins[:, 0]
-            off = 0
             t_done = time.perf_counter()
+            if tr is not None:
+                # finish spans BEFORE resolving futures: a caller that
+                # wakes on .result() must find its trace row complete
+                dispatch_ms = (t_d1 - t_d0) * 1e3
+                fill = rows / padded if padded else None
+                for req in batch:
+                    tr.finish(req.span,
+                              queue_wait_ms=(t_start - req.t_submit) * 1e3,
+                              batch_id=batch_id, flush_reason=reason,
+                              batch_rows=rows, batch_requests=len(batch),
+                              fill_ratio=fill, dispatch_ms=dispatch_ms,
+                              total_ms=(t_done - req.t_submit) * 1e3)
+            off = 0
             for req in batch:
                 req.future.set_result(margins[off:off + req.rows])
                 off += req.rows
@@ -212,16 +251,43 @@ class RequestCoalescer:
                 m.padded_rows.inc(padded)
                 if self.padded_rows:
                     m.fill.set(self.rows / self.padded_rows)
+                m.completed.labels(model=model, status="ok").inc(len(batch))
                 lat = m.latency.labels(model=model)
                 for req in batch:
-                    lat.observe((t_done - req.t_submit) * 1e3)
+                    lat.observe((t_done - req.t_submit) * 1e3,
+                                exemplar=(req.span.trace_id
+                                          if req.span is not None else None))
         except BaseException as exc:  # noqa: BLE001 — delivered via futures
+            t_err = time.perf_counter()
+            undone = [r for r in batch if not r.future.done()]
             with self._cv:
-                self.failures += sum(1 for r in batch
-                                     if not r.future.done())
-            if self._metrics is not None:
-                self._metrics.failures.inc(
-                    sum(1 for r in batch if not r.future.done()))
-            for req in batch:
-                if not req.future.done():
-                    req.future.set_exception(exc)
+                self.failures += len(undone)
+            m = self._metrics
+            if m is not None:
+                m.failures.inc(len(undone))
+                # failed requests still count as completed (status=
+                # "error") so completed ok+error == requests submitted
+                # even under injected engine errors
+                m.completed.labels(model=model,
+                                   status="error").inc(len(undone))
+                done_n = len(batch) - len(undone)
+                if done_n:
+                    m.completed.labels(model=model,
+                                       status="ok").inc(done_n)
+            if tr is not None:
+                err = f"{type(exc).__name__}: {exc}"
+                for req in batch:
+                    # status guard: a span already finished on the
+                    # success path (failure mid-resolution) stays ok
+                    if req.span is not None and req.span.status == "pending":
+                        tr.finish(req.span,
+                                  queue_wait_ms=(t_start - req.t_submit)
+                                  * 1e3,
+                                  batch_id=batch_id, flush_reason=reason,
+                                  batch_rows=rows,
+                                  batch_requests=len(batch),
+                                  fill_ratio=None, dispatch_ms=None,
+                                  total_ms=(t_err - req.t_submit) * 1e3,
+                                  status="error", error=err)
+            for req in undone:
+                req.future.set_exception(exc)

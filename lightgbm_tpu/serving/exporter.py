@@ -8,7 +8,10 @@ A stdlib `ThreadingHTTPServer` (no new dependencies) bound to
   including per-model latency histograms with interpolated _p50/_p99
   series and the HBM accountant gauges;
 * ``GET /metrics.json``  — the versioned snapshot dict (registry +
-  memory reconciliation) for tooling that prefers JSON.
+  memory reconciliation) for tooling that prefers JSON;
+* ``GET /debug/requests`` — the request tracer's live view (recent
+  ring, slowest-request table, burn rates) when ``tpu_serve_trace`` is
+  on; ``{"enabled": false}`` otherwise.
 
 Every scrape refreshes the HBM accountant first (`obs.memory.snapshot`
 reads owner callbacks + backend memory_stats at that moment), so the
@@ -47,6 +50,10 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(self.exporter.render_json(),
                                   sort_keys=True, default=str).encode()
                 ctype = "application/json"
+            elif path == "/debug/requests":
+                body = json.dumps(self.exporter.render_requests(),
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
             elif path in ("/", "/healthz"):
                 body = b"ok\n"
                 ctype = "text/plain"
@@ -69,8 +76,10 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsExporter:
     """HTTP scrape endpoint over the process metrics registry."""
 
-    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 tracer=None) -> None:
         obs_metrics.enable()
+        self.tracer = tracer
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True
@@ -90,6 +99,14 @@ class MetricsExporter:
         return {"schema": obs_metrics.SCHEMA_VERSION,
                 "metrics": obs_metrics.snapshot(),
                 "memory": obs_memory.snapshot()}
+
+    def render_requests(self) -> Dict[str, Any]:
+        """The /debug/requests document (request-trace ring + slow
+        table); a cheap {"enabled": false} stub with tracing off."""
+        if self.tracer is None:
+            return {"schema": 1, "enabled": False}
+        return dict({"schema": 1, "enabled": True},
+                    **self.tracer.snapshot())
 
     @property
     def url(self) -> str:

@@ -103,10 +103,14 @@ class ModelRegistry:
     """Named ForestEngine pool with HBM-budget LRU eviction."""
 
     def __init__(self, hbm_budget_mb: float = 0.0, warm_rows: int = 256,
-                 ledger=None) -> None:
+                 ledger=None, tracer=None) -> None:
         self.hbm_budget_bytes = int(max(float(hbm_budget_mb), 0.0) * 2**20)
         self.warm_rows = int(warm_rows)
         self.ledger = ledger
+        # request tracer (obs/reqtrace.py): load/swap/evict notes also
+        # land as MARKER rows in its ring so /debug/requests interleaves
+        # registry churn with the requests it slowed down
+        self._tracer = tracer
         self._lock = threading.RLock()
         self._entries: Dict[str, ModelEntry] = {}   # guarded-by: _lock
         self._tick = 0      # guarded-by: _lock (monotone LRU clock)
@@ -135,6 +139,10 @@ class ModelRegistry:
         if self.ledger is not None:
             self.ledger.commit(dict({"kind": "note", "note": kind},
                                     **fields))
+        if self._tracer is not None:
+            # marker row only (no second log.event) — the tracer lock is
+            # a leaf below self._lock, safe to take here
+            self._tracer.note(kind, **fields)
 
     # -- building ----------------------------------------------------------
     def _build_entry(self, name: str, model_str: str, version: str,

@@ -40,22 +40,34 @@ class ServingService:
             from ..utils import locks
             locks.set_debug_locks(True)
         # metrics must be on BEFORE the registry/coalescer resolve their
-        # instrument handles (they bind once at construction)
+        # instrument handles (they bind once at construction); the
+        # tracer binds its SLO instruments the same way, so it is built
+        # here too — after enable, before the registry
         self.exporter = None
         if cfg.tpu_serve_metrics_port or cfg.tpu_metrics:
             from ..obs import metrics as obs_metrics
             obs_metrics.enable()
+        self.tracer = None
+        if cfg.tpu_serve_trace:
+            from ..obs.reqtrace import RequestTracer
+            self.tracer = RequestTracer(
+                slo_ms=cfg.tpu_serve_slo_ms,
+                sample=cfg.tpu_serve_trace_sample,
+                ring_size=cfg.tpu_serve_trace_ring,
+                out_dir=cfg.tpu_serve_trace_dir)
         self.registry = ModelRegistry(
             hbm_budget_mb=cfg.tpu_serve_hbm_budget_mb,
             warm_rows=cfg.tpu_serve_warm_rows,
-            ledger=ledger)
+            ledger=ledger, tracer=self.tracer)
         self.coalescer = RequestCoalescer(
             self.registry,
             max_batch_wait_ms=cfg.tpu_serve_max_batch_wait_ms,
-            max_batch_rows=cfg.tpu_serve_max_batch_rows)
+            max_batch_rows=cfg.tpu_serve_max_batch_rows,
+            tracer=self.tracer)
         if cfg.tpu_serve_metrics_port:
             from .exporter import MetricsExporter
-            self.exporter = MetricsExporter(cfg.tpu_serve_metrics_port)
+            self.exporter = MetricsExporter(cfg.tpu_serve_metrics_port,
+                                            tracer=self.tracer)
         self._watchers: Dict[str, CheckpointWatcher] = {}
         self._closed = False
 
@@ -75,7 +87,8 @@ class ServingService:
         if w is not None:
             return w
         w = CheckpointWatcher(self.registry, name, checkpoint_dir,
-                              interval_s=self.config.tpu_serve_watch_interval_s)
+                              interval_s=self.config.tpu_serve_watch_interval_s,
+                              tracer=self.tracer)
         w.poll_once()
         self._watchers[name] = w
         return w.start()
@@ -97,6 +110,8 @@ class ServingService:
                              "versions": list(w.swapped)}
                          for n, w in self._watchers.items()},
         }
+        if self.tracer is not None:
+            out["reqtrace"] = self.tracer.totals()
         if self.exporter is not None:
             out["metrics_endpoint"] = self.exporter.url
         return out
@@ -107,9 +122,13 @@ class ServingService:
         self._closed = True
         for w in self._watchers.values():
             w.stop()
+        # coalescer drains before the tracer closes, so every in-flight
+        # request still lands its trace row (started == finished)
         self.coalescer.close()
         if self.exporter is not None:
             self.exporter.close()
+        if self.tracer is not None:
+            self.tracer.close()
 
     def __enter__(self) -> "ServingService":
         return self

@@ -35,11 +35,12 @@ class CheckpointWatcher:
     directly)."""
 
     def __init__(self, registry: ModelRegistry, name: str, directory: str,
-                 interval_s: float = 0.5) -> None:
+                 interval_s: float = 0.5, tracer=None) -> None:
         self.registry = registry
         self.name = name
         self.directory = directory
         self.interval_s = max(float(interval_s), 0.01)
+        self._tracer = tracer
         self.polls = 0
         self.swapped: list = []          # versions installed, in order
         self._last_version: Optional[str] = None
@@ -72,6 +73,10 @@ class CheckpointWatcher:
             # version and retry the pointer next tick
             log.event("serve_watch_bad_model", model=self.name,
                       version=version, error=str(exc))
+            if self._tracer is not None:
+                self._tracer.note("serve_watch_bad_model",
+                                  model=self.name, version=version,
+                                  error=str(exc))
             return False
         self._last_version = version
         self.swapped.append(version)

@@ -1,0 +1,387 @@
+"""Request-scoped serving traces (`obs/reqtrace.py`): span lifecycle,
+flush-reason attribution through the real coalescer, deterministic tail
+sampling, ring wraparound, histogram exemplars, SLO burn accounting,
+zero lost trace rows under a threaded hot swap, and the
+zero-overhead-off guarantee on the coalescer hot path.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import memory as obs_memory
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.obs import trace as obs_trace
+from lightgbm_tpu.obs.reqtrace import (RequestTracer, SLO_BURN_HIGH,
+                                       _sample_keep)
+from lightgbm_tpu.serving import (ModelRegistry, RequestCoalescer,
+                                  ServingService)
+from lightgbm_tpu.utils.log import (parse_event, register_callback,
+                                    set_verbosity)
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": -1}
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs_metrics.reset()
+    obs_memory.reset()
+    yield
+    obs_metrics.reset()
+    obs_memory.reset()
+
+
+@pytest.fixture
+def events():
+    lines = []
+    register_callback(lines.append)
+    set_verbosity(1)
+    yield lambda kind: [r for r in map(parse_event, lines)
+                        if r and r["event"] == kind]
+    register_callback(None)
+    set_verbosity(1)
+
+
+def _data(seed=0, n=400, f=8):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + 0.3 * rng.rand(n) > 0.6).astype(np.float64)
+    return X, y
+
+
+def _booster(seed=0, rounds=6):
+    X, y = _data(seed)
+    return lgb.train(dict(PARAMS, seed=seed), lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
+
+
+def _finish(tr, span, total_ms, status="ok", reason="full", **over):
+    kw = dict(queue_wait_ms=0.1, batch_id="b000001", flush_reason=reason,
+              batch_rows=8, batch_requests=2, fill_ratio=0.5,
+              dispatch_ms=total_ms / 2, total_ms=total_ms, status=status)
+    kw.update(over)
+    return tr.finish(span, **kw)
+
+
+# ----------------------------------------------------------------- tracer
+
+def test_span_lifecycle_and_row(tmp_path):
+    tr = RequestTracer(slo_ms=10.0, sample=1.0, ring_size=8,
+                       out_dir=str(tmp_path))
+    s = tr.start("ctr", 16)
+    assert s.status == "pending" and s.trace_id.startswith("r")
+    row = _finish(tr, s, total_ms=4.0)
+    assert row["kind"] == "request" and row["model"] == "ctr"
+    assert row["slo_breach"] is False and row["kept"] is True
+    assert row["dispatch_share"] == pytest.approx(0.5)
+    t = tr.totals()
+    assert t["started"] == t["finished"] == t["kept_rows"] == 1
+    tr.close()
+    tr.close()                                 # idempotent
+    rows = [json.loads(ln) for ln in open(tr.path)]
+    assert rows[0]["kind"] == "header"
+    assert rows[1]["trace_id"] == s.trace_id
+
+
+def test_tail_sampling_keeps_only_breachers_at_zero(tmp_path):
+    """sample=0 is pure tail sampling: SLO breachers and errors ALWAYS
+    land in the JSONL, nothing else does."""
+    tr = RequestTracer(slo_ms=5.0, sample=0.0, ring_size=64,
+                       out_dir=str(tmp_path))
+    kept_ids = set()
+    for i in range(30):
+        s = tr.start("m", 4)
+        if i % 3 == 0:
+            _finish(tr, s, total_ms=50.0)          # breach
+            kept_ids.add(s.trace_id)
+        elif i % 7 == 0:
+            _finish(tr, s, total_ms=1.0, status="error")   # error
+            kept_ids.add(s.trace_id)
+        else:
+            _finish(tr, s, total_ms=1.0)           # fast: dropped
+    tr.close()
+    rows = [json.loads(ln) for ln in open(tr.path)
+            if json.loads(ln)["kind"] == "request"]
+    assert {r["trace_id"] for r in rows} == kept_ids
+    assert all(r["slo_breach"] or r["status"] == "error" for r in rows)
+    # the ring still holds EVERY request regardless of sampling
+    assert tr.totals()["finished"] == 30
+    assert len([r for r in tr.recent()
+                if r["kind"] == "request"]) == 30
+
+
+def test_sampling_is_deterministic():
+    ids = [f"r00001-{i:08d}" for i in range(2000)]
+    first = [_sample_keep(t, 0.25) for t in ids]
+    assert first == [_sample_keep(t, 0.25) for t in ids]   # no RNG
+    frac = sum(first) / len(first)
+    assert 0.15 < frac < 0.35                # hash is roughly uniform
+    assert all(_sample_keep(t, 1.0) for t in ids[:10])
+    assert not any(_sample_keep(t, 0.0) for t in ids[:10])
+
+
+def test_ring_wraparound():
+    tr = RequestTracer(ring_size=8)
+    spans = [tr.start("m", 1) for _ in range(20)]
+    for s in spans:
+        _finish(tr, s, total_ms=1.0)
+    recent = tr.recent()
+    assert len(recent) == 8                 # fixed size, oldest gone
+    assert [r["trace_id"] for r in recent] == \
+        [s.trace_id for s in spans[-8:]]    # newest 8, oldest -> newest
+    assert tr.totals()["finished"] == 20
+    assert [r["trace_id"] for r in tr.recent(3)] == \
+        [s.trace_id for s in spans[-3:]]
+
+
+def test_burn_rate_gauge_and_events(tmp_path, events):
+    obs_metrics.enable()
+    tr = RequestTracer(slo_ms=1.0, sample=0.0)
+    for _ in range(20):
+        s = tr.start("hot", 4)
+        _finish(tr, s, total_ms=9.0)            # every request breaches
+    assert tr.burn_rates() == {"hot": 1.0}
+    snap = obs_metrics.snapshot()
+    assert snap["gauges"]['serve_slo_burn_rate{model="hot"}'] == 1.0
+    assert snap["counters"]['serve_slo_breaches_total{model="hot"}'] == 20.0
+    burns = events("serve_slo_burn")
+    assert len(burns) == 1                  # edge-triggered, not per-row
+    assert burns[0]["burn_rate"] >= SLO_BURN_HIGH
+    slows = events("serve_request_slow")
+    assert 1 <= len(slows) <= 3             # rate-limited pointer
+
+
+def test_marker_rows_interleave():
+    tr = RequestTracer(ring_size=16)
+    _finish(tr, tr.start("m", 1), total_ms=1.0)
+    tr.note("serve_swap", model="m", version="v2")
+    _finish(tr, tr.start("m", 1), total_ms=1.0)
+    kinds = [r["kind"] for r in tr.recent()]
+    assert kinds == ["request", "marker", "request"]
+    assert tr.snapshot()["totals"]["markers"] == 1
+
+
+# ----------------------------------------------- exemplars (obs/metrics)
+
+def test_histogram_exemplars_agree_with_buckets():
+    h = obs_metrics.registry().histogram("t_lat_ms")
+    h.observe(0.02, exemplar="r-a")           # first bucket (le 0.015625? no: 0.03125)
+    h.observe(3.0, exemplar="r-b")
+    h.observe(3.9, exemplar="r-c")            # same bucket: last wins
+    h.observe(7.0)                            # no exemplar: bucket unstamped
+    ex = h.exemplars()
+    bounds = list(h.bounds)
+    for le, rec in ex.items():
+        # the exemplar's value must actually fall in the bucket it stamps
+        ub = float("inf") if le == "+Inf" else float(le)
+        i = (len(bounds) if le == "+Inf"
+             else bounds.index(float(le)))
+        lb = bounds[i - 1] if i > 0 else 0.0
+        assert lb < rec["value_ms"] <= ub
+    assert ex[repr(4.0)]["trace_id"] == "r-c"   # last write won
+    snap_h = obs_metrics.snapshot()["histograms"]["t_lat_ms"]
+    assert snap_h["exemplars"] == ex
+    text = obs_metrics.to_prometheus()
+    bucket_lines = [ln for ln in text.splitlines() if "_bucket" in ln]
+    stamped = [ln for ln in bucket_lines if "# {trace_id=" in ln]
+    assert len(stamped) == len(ex)
+    assert any('le="4"' in ln and 'trace_id="r-c"' in ln
+               for ln in stamped)
+    # non-bucket series keep `last token is the value` parseable
+    for ln in text.splitlines():
+        if "_bucket" not in ln and not ln.startswith("#") and ln:
+            float(ln.split()[-1])
+
+
+def test_histogram_without_exemplars_unchanged():
+    h = obs_metrics.registry().histogram("t_plain_ms")
+    h.observe(1.0)
+    assert h.exemplars() == {}
+    assert "exemplars" not in \
+        obs_metrics.snapshot()["histograms"]["t_plain_ms"]
+    assert "# {" not in obs_metrics.to_prometheus()
+
+
+# ------------------------------------------------- coalescer integration
+
+def test_flush_reason_attribution(tmp_path):
+    """A full-bucket flush and a deadline flush produce trace rows whose
+    flush_reason, batch grouping, and timing fields say which was which."""
+    bst, X = _booster()
+    tr = RequestTracer(sample=1.0, out_dir=str(tmp_path))
+    reg = ModelRegistry()
+    reg.load("m", model_str=bst.model_to_string())
+    with RequestCoalescer(reg, max_batch_wait_ms=200.0,
+                          max_batch_rows=64, tracer=tr) as co:
+        co.submit("m", X[:1]).result(timeout=60)   # warm (deadline flush)
+        f1 = co.submit("m", X[:32])
+        f2 = co.submit("m", X[32:64])              # fills the bucket
+        f1.result(timeout=60)
+        f2.result(timeout=60)
+        f3 = co.submit("m", X[:4])                 # lone -> deadline
+        f3.result(timeout=60)
+    rows = {r["trace_id"]: r
+            for r in tr.recent() if r["kind"] == "request"}
+    assert len(rows) == 4
+    by_reason = {}
+    for r in rows.values():
+        by_reason.setdefault(r["flush_reason"], []).append(r)
+    full = by_reason["full"]
+    assert len(full) == 2                   # the two bucket-filling reqs
+    assert {r["batch_id"] for r in full} == {full[0]["batch_id"]}
+    assert all(r["batch_requests"] == 2 and r["batch_rows"] == 64
+               for r in full)
+    assert len(by_reason["deadline"]) == 2  # warm-up + the lone request
+    for r in rows.values():
+        assert r["queue_wait_ms"] is not None and r["queue_wait_ms"] >= 0
+        assert r["dispatch_ms"] is not None
+        assert 0 < r["dispatch_share"] <= 1
+        assert 0 < r["fill_ratio"] <= 1
+        assert r["status"] == "ok"
+    # deadline flush of a lone request actually waited for the SLO
+    lone = [r for r in by_reason["deadline"]
+            if r["batch_requests"] == 1 and r["batch_rows"] == 4]
+    assert lone and lone[0]["queue_wait_ms"] >= 150.0
+
+
+def test_error_batch_still_traces(tmp_path, events):
+    """The error path delivers a trace row per request even though the
+    engine call never happened (unknown model), and close(drain=False)
+    finishes queued spans — started == finished always."""
+    bst, X = _booster()
+    set_verbosity(1)
+    tr = RequestTracer(slo_ms=1e9, sample=0.0, out_dir=str(tmp_path))
+    reg = ModelRegistry()
+    reg.load("m", model_str=bst.model_to_string())
+    co = RequestCoalescer(reg, max_batch_wait_ms=1.0, tracer=tr)
+    bad = co.submit("nope", X[:2])
+    with pytest.raises(KeyError):
+        bad.result(timeout=60)
+    co.submit("m", X[:2]).result(timeout=60)
+    co.close()
+    t = tr.totals()
+    assert t["started"] == t["finished"] == 2
+    assert t["errors"] == 1
+    err_rows = [r for r in tr.recent()
+                if r["kind"] == "request" and r["status"] == "error"]
+    assert len(err_rows) == 1
+    assert "nope" in err_rows[0]["error"]
+    assert err_rows[0]["kept"] is True      # errors always tail-kept
+    # undrained close: queued spans finish as errors too
+    tr2 = RequestTracer()
+    co2 = RequestCoalescer(reg, max_batch_wait_ms=60000.0, tracer=tr2)
+    fut = co2.submit("m", X[:2])
+    co2.close(drain=False)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=60)
+    t2 = tr2.totals()
+    assert t2["started"] == t2["finished"] == 1
+    assert [r["flush_reason"] for r in tr2.recent()] == ["closed"]
+
+
+def test_no_lost_trace_rows_under_hot_swap(tmp_path):
+    """The threaded swap-under-load scenario: every submitted request
+    yields exactly one trace row — no losses, no duplicates — while the
+    served model hot-swaps mid-traffic."""
+    b1, X = _booster(seed=0, rounds=4)
+    b2, _ = _booster(seed=1, rounds=4)
+    svc = ServingService(params={
+        "tpu_serve_trace": True,
+        "tpu_serve_trace_dir": str(tmp_path),
+        "tpu_serve_trace_sample": 1.0,
+        "tpu_serve_max_batch_wait_ms": 1.0,
+    })
+    svc.load_model("m", model_str=b1.model_to_string())
+    n_per, clients = 25, 4
+    fails = [0]
+
+    def worker(ci):
+        for i in range(n_per):
+            try:
+                svc.predict("m", X[(ci * n_per + i) % 300:][:8],
+                            timeout=60)
+            except Exception:
+                fails[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    svc.registry.swap("m", b2.model_to_string(), version="v2")
+    for t in threads:
+        t.join()
+    svc.close()
+    assert fails[0] == 0
+    n = n_per * clients
+    totals = svc.tracer.totals()
+    assert totals["started"] == totals["finished"] == n
+    rows = [json.loads(ln) for ln in open(svc.tracer.path)]
+    reqs = [r for r in rows if r["kind"] == "request"]
+    assert len(reqs) == n                          # zero lost rows
+    assert len({r["trace_id"] for r in reqs}) == n  # zero duplicates
+    assert all(r["status"] == "ok" for r in reqs)
+    # the swap landed as a marker row in the same stream
+    assert any(r["kind"] == "marker" and r["marker"] == "serve_swap"
+               for r in rows)
+
+
+# ----------------------------------------------------- zero-overhead-off
+
+def test_tracing_off_is_off(monkeypatch):
+    """With tpu_serve_trace off the coalescer hot path holds tracer=None
+    (one is-None branch) and issues ZERO device fences beyond the
+    untraced baseline (which is also zero with tpu_trace off)."""
+    fences = []
+    monkeypatch.setattr(obs_trace, "_block",
+                        lambda x: fences.append(1) or x)
+    bst, X = _booster()
+    with ServingService(params={
+            "tpu_serve_max_batch_wait_ms": 1.0}) as svc:
+        assert svc.tracer is None
+        assert svc.coalescer._tracer is None       # the one branch
+        assert svc.registry._tracer is None
+        svc.load_model("m", model_str=bst.model_to_string())
+        svc.predict("m", X[:16], timeout=60)
+        st = svc.stats()
+    assert "reqtrace" not in st                    # stats() unchanged
+    assert fences == [], "disabled tracing issued a device fence"
+
+
+def test_service_stats_and_debug_endpoint(tmp_path):
+    from lightgbm_tpu.serving.exporter import MetricsExporter
+    bst, X = _booster()
+    obs_metrics.enable()
+    svc = ServingService(params={
+        "tpu_serve_trace": True,
+        "tpu_serve_trace_sample": 1.0,
+        "tpu_serve_max_batch_wait_ms": 1.0,
+    })
+    exp = MetricsExporter(0, tracer=svc.tracer)
+    try:
+        svc.load_model("m", model_str=bst.model_to_string())
+        svc.predict("m", X[:16], timeout=60)
+        assert svc.stats()["reqtrace"]["finished"] == 1
+        import urllib.request
+        doc = json.loads(urllib.request.urlopen(
+            exp.url + "/debug/requests", timeout=10).read())
+        assert doc["enabled"] is True
+        assert doc["totals"]["finished"] == 1
+        assert [r for r in doc["recent"] if r["kind"] == "request"]
+        assert doc["slow"][0]["trace_id"].startswith("r")
+    finally:
+        exp.close()
+        svc.close()
+    # without a tracer the endpoint answers a cheap stub
+    exp2 = MetricsExporter(0)
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            exp2.url + "/debug/requests", timeout=10).read())
+        assert doc == {"schema": 1, "enabled": False}
+    finally:
+        exp2.close()

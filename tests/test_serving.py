@@ -253,6 +253,38 @@ def test_coalescer_error_delivery_and_close():
         co.submit("m", X[:2])
 
 
+def test_coalescer_error_batches_kept_in_request_accounting():
+    """Regression: requests that die in a failed batch must still show
+    up in the per-model completion counters — completed ok + error
+    equals requests submitted, even under injected engine errors."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    obs_metrics.reset()
+    obs_metrics.enable()
+    try:
+        bst, X = _booster()
+        reg = ModelRegistry()
+        reg.load("m", model_str=bst.model_to_string())
+        co = RequestCoalescer(reg, max_batch_wait_ms=1.0)
+        futs_bad = [co.submit("nope", X[:2]) for _ in range(3)]
+        futs_ok = [co.submit("m", X[:2]) for _ in range(2)]
+        for f in futs_bad:
+            with pytest.raises(KeyError):
+                f.result(timeout=60)
+        for f in futs_ok:
+            f.result(timeout=60)
+        co.close()
+        snap = obs_metrics.snapshot()["counters"]
+        ok = snap.get('serve_requests_completed_total'
+                      '{model="m",status="ok"}', 0.0)
+        err = snap.get('serve_requests_completed_total'
+                       '{model="nope",status="error"}', 0.0)
+        assert ok == 2.0 and err == 3.0
+        assert ok + err == snap["serve_requests_total"] == 5.0
+        assert snap["serve_failures_total"] == 3.0
+    finally:
+        obs_metrics.reset()
+
+
 def test_coalescer_wait_slo_is_not_a_floor():
     """5 s SLO must not make a 1-row request take 5 s when close() drains
     (regression guard for shutdown hangs)."""

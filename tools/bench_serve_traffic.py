@@ -176,7 +176,9 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
         train_rows: int = 8000, train_rounds: int = 60,
         num_features: int = 20, wait_ms: float = 1.0,
         max_batch_rows: int = 2048, hbm_budget_mb: float = 0.0,
-        seed: int = 0, ledger=None, verbose: bool = False) -> dict:
+        seed: int = 0, ledger=None, verbose: bool = False,
+        trace_dir=None, trace_sample: float = 1.0,
+        slo_ms: float = 0.0) -> dict:
     from lightgbm_tpu.serving import ServingService
 
     def say(msg):
@@ -189,12 +191,21 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
     say(f"trained {models} models (+1 swap candidate) "
         f"in {time.perf_counter() - t_all:.1f}s")
 
-    svc = ServingService(params={
+    svc_params = {
         "tpu_serve_max_batch_wait_ms": wait_ms,
         "tpu_serve_max_batch_rows": max_batch_rows,
         "tpu_serve_hbm_budget_mb": hbm_budget_mb,
         "tpu_serve_warm_rows": 256,
-    }, ledger=ledger)
+    }
+    if trace_dir is not None:
+        # request-tracing leg: every request spans through obs/reqtrace
+        svc_params.update({
+            "tpu_serve_trace": True,
+            "tpu_serve_trace_dir": str(trace_dir),
+            "tpu_serve_trace_sample": trace_sample,
+            "tpu_serve_slo_ms": slo_ms,
+        })
+    svc = ServingService(params=svc_params, ledger=ledger)
     names = [f"m{i}" for i in range(models)]
     try:
         t0 = time.perf_counter()
@@ -253,7 +264,14 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
         p50d, p99d = _percentiles(lat_dir)
         p50c, p99c = _percentiles(lat_co)
         stats = svc.stats()
-        return {
+        trace_rec = {}
+        if svc.tracer is not None:
+            # drain in-flight batches so started == finished before the
+            # totals are read (close() is idempotent; the finally-close
+            # below is then a no-op)
+            svc.coalescer.close()
+            trace_rec["serve_trace"] = svc.tracer.totals()
+        return dict(trace_rec, **{
             "serve_models": models,
             "serve_rows_per_req": rows_per_req,
             "serve_clients": clients,
@@ -276,7 +294,7 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
             "serve_swaps": stats["registry"]["swaps"],
             "serve_resident_bytes": stats["registry"]["total_bytes"],
             "serve_wall_s": round(time.perf_counter() - t_all, 1),
-        }
+        })
     finally:
         svc.close()
 

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Ranked slow-request report: merge the serving plane's request-trace
+JSONL (obs/reqtrace.py), the serving ledger, and a metrics snapshot
+into one ordered answer to "which requests were slow, and why".
+
+Inputs (each optional — the report ranks whatever is available):
+
+  --reqtrace PATH   a reqtrace-*.jsonl file, or a directory holding
+                    them (every file in the directory is merged)
+  --ledger PATH     serving ledger JSONL (load/swap/evict note records
+                    join the request timeline)
+  --metrics PATH    a /metrics.json capture (or exporter render_json
+                    dump); per-model p99 and histogram exemplars are
+                    cross-checked against the trace rows
+  --slo-ms MS       override the SLO used for breach ranking (default:
+                    the JSONL header's slo_ms)
+  --json PATH       also write the full report as JSON
+  --top N           rows per section in the text report (default 10)
+
+The report:
+
+  1. per-model aggregates — request count, breach/error rates, queue-
+     wait vs dispatch share of total latency (is the tail the batcher's
+     fault or the engine's?), flush-reason mix
+  2. ranked slow requests — worst total_ms first, each with its queue
+     wait, batch id/fill, dispatch share, and any registry marker
+     (swap/evict/load) that landed within --corr-window seconds before
+     it (the usual smoking gun for a latency spike)
+  3. exemplar resolution — every histogram bucket exemplar in the
+     metrics snapshot resolved (or not) against the trace rows, so the
+     p99 a dashboard shows links to a concrete request here
+
+Exit code 0 whenever a report was produced (even a partial one); 2 when
+NO input yielded any data.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _load_json(path, what):
+    if not path:
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except Exception as e:  # noqa: BLE001 — partial reports are fine
+        log(f"# {what} unreadable ({type(e).__name__}): {path}")
+        return None
+
+
+def load_reqtrace(path):
+    """(header, request_rows, marker_rows) from a reqtrace JSONL file
+    or a directory of them. Unparseable lines are skipped (a killed
+    writer can leave one torn tail line)."""
+    files = []
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "reqtrace-*.jsonl")))
+    elif os.path.isfile(path):
+        files = [path]
+    header, requests, markers = None, [], []
+    for f in files:
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                kind = row.get("kind")
+                if kind == "header":
+                    header = row
+                elif kind == "request":
+                    requests.append(row)
+                elif kind == "marker":
+                    markers.append(row)
+    return header, requests, markers
+
+
+def model_aggregates(requests, slo_ms):
+    """Per-model latency/breach/attribution aggregates."""
+    by_model = {}
+    for r in requests:
+        by_model.setdefault(r.get("model") or "?", []).append(r)
+    out = []
+    for model, rows in sorted(by_model.items()):
+        lat = sorted(r["total_ms"] for r in rows
+                     if r.get("total_ms") is not None)
+
+        def pct(q):
+            if not lat:
+                return None
+            return round(lat[min(int(q * len(lat)), len(lat) - 1)], 3)
+
+        def mean(key):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            return round(sum(vals) / len(vals), 4) if vals else None
+
+        reasons = {}
+        for r in rows:
+            reasons[r.get("flush_reason") or "?"] = \
+                reasons.get(r.get("flush_reason") or "?", 0) + 1
+        errors = sum(1 for r in rows if r.get("status") != "ok")
+        breaches = (sum(1 for r in rows if r.get("slo_breach"))
+                    if slo_ms else 0)
+        out.append({
+            "model": model, "requests": len(rows),
+            "errors": errors, "breaches": breaches,
+            "breach_rate": round(breaches / len(rows), 4),
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "mean_queue_wait_ms": mean("queue_wait_ms"),
+            "mean_dispatch_share": mean("dispatch_share"),
+            "mean_fill_ratio": mean("fill_ratio"),
+            "flush_reasons": reasons,
+        })
+    out.sort(key=lambda r: -(r["p99_ms"] or 0.0))
+    return out
+
+
+def slow_requests(requests, markers, top, corr_window_s):
+    """Worst requests by total_ms, each joined against registry
+    markers that landed shortly before its completion."""
+    rows = sorted((r for r in requests if r.get("total_ms") is not None),
+                  key=lambda r: -r["total_ms"])[:top]
+    out = []
+    for r in rows:
+        near = [m for m in markers
+                if r.get("ts") is not None and m.get("ts") is not None
+                and 0 <= r["ts"] - m["ts"] <= corr_window_s]
+        rec = dict(r)
+        if near:
+            rec["nearby_markers"] = [
+                {"marker": m.get("marker"),
+                 "model": m.get("model"),
+                 "dt_s": round(r["ts"] - m["ts"], 3)}
+                for m in sorted(near, key=lambda m: m["ts"])]
+        out.append(rec)
+    return out
+
+
+def resolve_exemplars(metrics_doc, requests):
+    """Every histogram exemplar in the snapshot, resolved against the
+    trace rows — `resolved` False means the dashboard points at a
+    request the sampler dropped (or a different trace file)."""
+    if metrics_doc is None:
+        return []
+    snap = metrics_doc.get("metrics", metrics_doc)
+    hists = snap.get("histograms") or {}
+    known = {r["trace_id"] for r in requests}
+    out = []
+    for hname, h in sorted(hists.items()):
+        for le, ex in sorted((h.get("exemplars") or {}).items()):
+            out.append({"histogram": hname, "le": le,
+                        "trace_id": ex.get("trace_id"),
+                        "value_ms": ex.get("value_ms"),
+                        "resolved": ex.get("trace_id") in known})
+    return out
+
+
+def build_report(args):
+    header, requests, markers = (None, [], [])
+    if args.reqtrace:
+        header, requests, markers = load_reqtrace(args.reqtrace)
+        if not requests and not markers:
+            log(f"# no trace rows under {args.reqtrace}")
+    slo_ms = args.slo_ms if args.slo_ms is not None else \
+        float((header or {}).get("slo_ms") or 0.0)
+    metrics_doc = _load_json(args.metrics, "metrics snapshot")
+    ledger_notes = []
+    if args.ledger and os.path.isfile(args.ledger):
+        try:
+            from lightgbm_tpu.obs.ledger import read_ledger
+            ledger_notes = [r for r in read_ledger(args.ledger)
+                            if r.get("kind") == "note"]
+        except Exception as e:  # noqa: BLE001
+            log(f"# ledger unreadable ({type(e).__name__}): "
+                f"{args.ledger}")
+    report = {
+        "schema": 1,
+        "inputs": {"reqtrace": args.reqtrace, "ledger": args.ledger,
+                   "metrics": args.metrics},
+        "header": header,
+        "slo_ms": slo_ms,
+        "totals": {
+            "requests": len(requests),
+            "markers": len(markers),
+            "errors": sum(1 for r in requests
+                          if r.get("status") != "ok"),
+            "breaches": sum(1 for r in requests
+                            if r.get("slo_breach")),
+        },
+        "models": model_aggregates(requests, slo_ms),
+        "slow_requests": slow_requests(requests, markers, args.top,
+                                       args.corr_window),
+        "exemplars": resolve_exemplars(metrics_doc, requests),
+    }
+    if ledger_notes:
+        report["ledger_notes"] = [
+            {"note": n.get("note"), "model": n.get("model"),
+             "version": n.get("version")} for n in ledger_notes]
+    return report
+
+
+def print_report(report, top):
+    p = print
+    p("=" * 64)
+    p("request-trace report — ranked slow requests")
+    p("=" * 64)
+    t = report["totals"]
+    p(f"\nrequests={t['requests']} breaches={t['breaches']} "
+      f"errors={t['errors']} markers={t['markers']} "
+      f"slo_ms={report['slo_ms']:g}")
+    models = report.get("models") or []
+    if models:
+        p("\nper-model aggregates (worst p99 first):")
+        for m in models[:top]:
+            p(f"  {m['model']:<12} n={m['requests']:<6} "
+              f"p50={m['p50_ms']} ms  p99={m['p99_ms']} ms  "
+              f"breach={m['breach_rate'] * 100:.1f}%  "
+              f"queue_wait~{m['mean_queue_wait_ms']} ms  "
+              f"dispatch_share~{m['mean_dispatch_share']}  "
+              f"reasons={m['flush_reasons']}")
+    slow = report.get("slow_requests") or []
+    if slow:
+        p("\nslowest requests:")
+        for i, r in enumerate(slow[:top], 1):
+            flags = "".join((
+                "B" if r.get("slo_breach") else "",
+                "E" if r.get("status") != "ok" else ""))
+            p(f"  {i:>2}. {r['trace_id']}  {r.get('total_ms')} ms "
+              f"[{flags or ' '}] model={r.get('model')} "
+              f"wait={r.get('queue_wait_ms')} ms "
+              f"batch={r.get('batch_id')}/{r.get('flush_reason')} "
+              f"fill={r.get('fill_ratio')} "
+              f"dshare={r.get('dispatch_share')}")
+            for m in r.get("nearby_markers") or []:
+                p(f"        <- {m['marker']} model={m['model']} "
+                  f"{m['dt_s']}s earlier")
+    ex = report.get("exemplars") or []
+    if ex:
+        unresolved = sum(1 for e in ex if not e["resolved"])
+        p(f"\nhistogram exemplars ({len(ex)} total, "
+          f"{unresolved} unresolved):")
+        for e in ex[:top]:
+            mark = "ok" if e["resolved"] else "MISSING"
+            p(f"  {e['histogram']} le={e['le']}: {e['trace_id']} "
+              f"({e['value_ms']} ms) [{mark}]")
+    p("")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="ranked slow-request report from request traces")
+    ap.add_argument("--reqtrace", default="")
+    ap.add_argument("--ledger", default="")
+    ap.add_argument("--metrics", default="")
+    ap.add_argument("--slo-ms", type=float, default=None)
+    ap.add_argument("--corr-window", type=float, default=5.0,
+                    help="seconds before a slow request in which a "
+                         "registry marker counts as 'nearby'")
+    ap.add_argument("--json", default="", dest="json_out")
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args(argv)
+    report = build_report(args)
+    has_data = bool(report["totals"]["requests"]
+                    or report["totals"]["markers"]
+                    or report.get("exemplars"))
+    print_report(report, args.top)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+        log(f"# json report: {args.json_out}")
+    if not has_data:
+        log("# no usable input (need --reqtrace/--metrics)")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
