@@ -88,7 +88,8 @@ Env knobs: BENCH_ROWS, BENCH_FEATURES, BENCH_ITERS (measured), BENCH_WARMUP,
 BENCH_LEAVES, BENCH_SMOKE=1 (tiny CPU config), BENCH_BUDGET_S,
 BENCH_SKIP_RANK=1, BENCH_SKIP_255=1, BENCH_SKIP_PREDICT=1,
 BENCH_SKIP_WARM=1, BENCH_SKIP_VALID=1, BENCH_SKIP_REF=1,
-BENCH_SKIP_RESUME=1, BENCH_SKIP_SERVE=1, BENCH_PROFILE=0 (disable the
+BENCH_SKIP_RESUME=1, BENCH_SKIP_SERVE=1, BENCH_SKIP_SWEEP=1,
+BENCH_PROFILE=0 (disable the
 per-term profiler rounds), BENCH_OUT=<path> (sidecar record),
 BENCH_TRACE=1 + BENCH_TRACE_DIR (obs span tracer + per-stage ledger
 records).
@@ -825,6 +826,58 @@ def run_resume(X, y, leaves, iters):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def run_sweep(X, y, leaves, iters, M):
+    """Many-model fleet throughput (sweep/train_many): one batched
+    vmapped round program for M boosters vs M sequential engine.train
+    runs over the same grid and the same constructed Dataset. Models
+    are trained under tpu_use_f64_hist so the fleet/sequential pair is
+    asserted byte-equal — the speedup is never quoted over diverging
+    models. One trace warm-up run precedes each arm (the sweep_round
+    program for the batched arm, the per-tree programs for the
+    sequential arm), so both walls are steady-state."""
+    from lightgbm_tpu.obs import memory as obs_memory
+    from lightgbm_tpu.sweep import train_many
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "tpu_use_f64_hist": True, "verbosity": -1}
+    lrs = np.linspace(0.05, 0.3, M)
+    l2s = np.linspace(0.0, 3.0, M)
+    grids = [dict(params, learning_rate=round(float(lr), 4),
+                  lambda_l2=round(float(l2), 4))
+             for lr, l2 in zip(lrs, l2s)]
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+
+    train_many([dict(p) for p in grids], ds, num_boost_round=1)
+    t0 = time.perf_counter()
+    fleet = train_many([dict(p) for p in grids], ds,
+                       num_boost_round=iters)
+    bat_s = time.perf_counter() - t0
+    # the fleet's live sweep/scores owner row dies with train_many's
+    # frame, so the measured stack size rides out on the boosters
+    owners = obs_memory.snapshot().get("owners", {})
+    stack_bytes = getattr(
+        fleet[0], "_sweep_scores_bytes",
+        owners.get("sweep/scores", {}).get("bytes", 0))
+    hbm_mb = stack_bytes / 1e6 / M
+
+    lgb.train(dict(grids[0]), ds, num_boost_round=1)
+    t0 = time.perf_counter()
+    seq = [lgb.train(dict(p), ds, num_boost_round=iters) for p in grids]
+    seq_s = time.perf_counter() - t0
+
+    equal = all(a.model_to_string() == b.model_to_string()
+                for a, b in zip(fleet, seq))
+    models_per_s = round(M / max(bat_s, 1e-9), 3)
+    speedup = round(seq_s / max(bat_s, 1e-9), 2)
+    log(f"# sweep m={M}: batched {bat_s:.2f}s vs sequential "
+        f"{seq_s:.2f}s -> {speedup}x, {models_per_s} models/s, "
+        f"{hbm_mb:.2f} MB scores/model, byte_equal={equal}")
+    return {f"sweep_models_per_s_m{M}": models_per_s,
+            f"sweep_speedup_m{M}": speedup,
+            f"sweep_hbm_per_model_mb_m{M}": round(hbm_mb, 3),
+            f"sweep_byte_equal_m{M}": bool(equal)}
+
+
 def run_warm_rerun(out):
     """Spawn the fresh-process warm rerun and record cold vs warm."""
     import subprocess
@@ -1071,6 +1124,35 @@ def main() -> None:
         except Exception as e:   # the summary line must still print
             log(f"# resume stage FAILED: {type(e).__name__}: {e}")
         _stage_done("resume", out)
+
+    # ---- stage 5.6: many-model sweep (sweep/train_many): one batched
+    # program for the fleet vs M sequential runs, byte-equal asserted --
+    if stage_gate(out, "sweep", "BENCH_SKIP_SWEEP",
+                  est_s=_GATE.wall("higgs63") * (0.8 if smoke else 2.0)):
+        _stage("sweep")
+        try:
+            sw_iters = 10 if smoke else 30
+            sw_rows = min(len(X), 20_000 if smoke else 100_000)
+            t8 = time.perf_counter()
+            out.update(run_sweep(X[:sw_rows], y[:sw_rows], leaves,
+                                 sw_iters, 8))
+            t8 = time.perf_counter() - t8
+            # M=32 scales the sequential arm 4x; run it only when the
+            # measured M=8 wall says it still fits the budget
+            left = budget_left()
+            if smoke:
+                out.setdefault("stage_skips", {})["sweep_m32"] = \
+                    "BENCH_SMOKE=1"
+            elif left is not None and left < t8 * 3.5:
+                out.setdefault("stage_skips", {})["sweep_m32"] = (
+                    f"adaptive skip: m32 needs ~{t8 * 3.5:.0f}s, "
+                    f"{left:.0f}s left")
+            else:
+                out.update(run_sweep(X[:sw_rows], y[:sw_rows], leaves,
+                                     sw_iters, 32))
+        except Exception as e:   # the summary line must still print
+            log(f"# sweep stage FAILED: {type(e).__name__}: {e}")
+        _stage_done("sweep", out)
 
     # ---- stage 5.7: MULTICHIP scaling curve (dist/ runtime): fixed
     # global rows at mesh widths 1..N, one fresh child per width --------

@@ -532,6 +532,30 @@ if [ "$BC_RC" -eq 0 ]; then
     exit 1
 fi
 echo "bench_compare gate: ok (injected regression exits $BC_RC)"
+# a sweep-throughput drop is a gated direction too: inject one and the
+# gate must fail the same way
+LGBT_BC_DIR="$BC_DIR" python - <<'EOF'
+import json
+import os
+
+d = os.environ["LGBT_BC_DIR"]
+base = {"metric": "higgs_synth_500iter_s", "unit": "s", "value": 300.0,
+        "sweep_models_per_s_m8": 4.0, "sweep_speedup_m8": 5.0}
+json.dump(base, open(os.path.join(d, "sa.json"), "w"))
+json.dump(dict(base, sweep_models_per_s_m8=2.0, sweep_speedup_m8=2.5),
+          open(os.path.join(d, "sb.json"), "w"))
+EOF
+set +e
+python tools/bench_compare.py "$BC_DIR/sa.json" "$BC_DIR/sb.json" --gate \
+    > "$BC_DIR/sweep_gate.log" 2>&1
+BC_RC=$?
+set -e
+if [ "$BC_RC" -eq 0 ]; then
+    echo "FAIL: bench_compare --gate passed an injected sweep regression" >&2
+    cat "$BC_DIR/sweep_gate.log" >&2
+    exit 1
+fi
+echo "bench_compare sweep gate: ok (injected fleet slowdown exits $BC_RC)"
 rm -rf "$BC_DIR"
 
 echo "== lambdarank fused smoke (5 rounds, tpu_rank_fused=on, rank_grad) =="
@@ -587,6 +611,66 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
     echo "device-time artifact kept under $RANK_DIR for artifact upload"
 else
     rm -rf "$(dirname "$RANK_DIR")"
+fi
+
+echo "== many-model sweep smoke (M=4 batched, byte-equal vs sequential twins) =="
+SWEEP_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_sweep"
+mkdir -p "$SWEEP_DIR"
+SWEEP_SMOKE_DIR="$SWEEP_DIR" python - <<'EOF'
+import filecmp
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.ledger import read_ledger, validate_record
+from lightgbm_tpu.sweep import train_many
+
+out = os.environ["SWEEP_SMOKE_DIR"]
+tdir = os.path.join(out, "trace")
+rng = np.random.RandomState(5)
+X = rng.rand(300, 8).astype(np.float32)
+y = (X[:, 0] + X[:, 4] * 0.5 + rng.rand(300) * 0.1).astype(np.float32)
+base = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+        "tpu_use_f64_hist": True, "tpu_grow_mode": "leafwise",
+        "verbosity": -1, "tpu_trace": True, "tpu_trace_dir": tdir}
+grids = [dict(base, learning_rate=lr, lambda_l2=l2)
+         for lr, l2 in [(0.1, 0.0), (0.05, 1.0), (0.2, 0.5), (0.3, 2.0)]]
+ROUNDS = 5
+fleet = train_many([dict(p) for p in grids], lgb.Dataset(X, label=y),
+                   num_boost_round=ROUNDS)
+for m, (bst, params) in enumerate(zip(fleet, grids)):
+    seq = lgb.train(dict(params, tpu_trace=False),
+                    lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    a = os.path.join(out, f"fleet_{m}.txt")
+    b = os.path.join(out, f"seq_{m}.txt")
+    bst.save_model(a)
+    seq.save_model(b)
+    assert filecmp.cmp(a, b, shallow=False), f"model {m} diverged"
+# fleet ledger: every record schema-valid, EXACTLY one sweep_init note,
+# and the round records partition cleanly by the per-model key
+rows = []
+for name in sorted(os.listdir(tdir)):
+    if name.startswith("ledger-"):
+        rows.extend(read_ledger(os.path.join(tdir, name)))
+for rec in rows:
+    validate_record(rec)
+inits = [r for r in rows if r.get("kind") == "note"
+         and r.get("note") == "sweep_init"]
+assert len(inits) == 1, f"sweep_init notes: {len(inits)}"
+assert inits[0]["models"] == 4 and inits[0]["mode"] == "batched", inits
+rounds = [r for r in rows if r.get("kind") == "round"
+          and r.get("path") == "sweep"]
+by_model = {m: sorted(r["round"] for r in rounds if r.get("model") == m)
+            for m in range(4)}
+assert all(v == list(range(ROUNDS)) for v in by_model.values()), by_model
+print(f"sweep smoke: ok (4 models byte-equal over {ROUNDS} rounds, "
+      f"{len(rounds)} per-model ledger rounds, 1 sweep_init note)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "sweep artifacts kept under $SWEEP_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$SWEEP_DIR")"
 fi
 
 echo "== bench kill smoke (SIGTERM mid-stage -> last line still parses) =="

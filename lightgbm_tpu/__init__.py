@@ -10,13 +10,13 @@ from .basic import Booster, Dataset, LightGBMError
 from .callback import (EarlyStopException, early_stopping, log_telemetry,
                        print_evaluation, record_evaluation, reset_parameter)
 from .config import Config
-from .engine import cv, train
+from .engine import cv, train, train_many
 
 __version__ = "2.2.4"  # capability parity target (reference VERSION.txt)
 
 __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError",
-    "train", "cv",
+    "train", "cv", "train_many",
     "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "log_telemetry", "EarlyStopException",
 ]

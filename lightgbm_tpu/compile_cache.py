@@ -32,7 +32,7 @@ import contextlib
 import hashlib
 import os
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -186,6 +186,14 @@ def program(key: Any, factory: Callable[[], Callable]) -> Callable:
 
 def registry_size() -> int:
     return len(_programs)
+
+
+def registered_program_tags() -> List[str]:
+    """Tags of every registered program (miss-attribution surface: the
+    fleet's sweep_round programs show up here next to the sequential
+    ones, so a registry dump names what traced)."""
+    with _lock:
+        return sorted(program_tag(k) for k in _programs)
 
 
 def clear_programs() -> None:

@@ -661,6 +661,22 @@ class Config:
     # jax.profiler trace; artifact directory paths land in
     # trace_summary.json. Empty disables capture
     tpu_profile_capture: str = ""
+    # many-model sweep trainer (sweep/train_many): "auto" batches the
+    # whole fleet into one vmapped round program when every member
+    # shares shapes outside the sweep grid (learning_rate, lambda_l1/l2,
+    # bagging seed+freq, feature_fraction_seed may vary), falling back
+    # to an interleaved round-robin of per-model rounds otherwise;
+    # "batched" raises instead of falling back; "interleaved" forces the
+    # fallback. Runtime-only: excluded from model text and checkpoint
+    # signatures — model bytes are identical across modes
+    tpu_sweep_mode: str = "auto"
+    # fleet checkpoint directory for train_many (MANIFEST.json + per-
+    # model texts + score planes + host RNG). Empty disables fleet
+    # checkpointing. Runtime-only, like tpu_checkpoint_dir
+    tpu_sweep_checkpoint_dir: str = ""
+    # write a fleet checkpoint every N sweep rounds (0 = never).
+    # Runtime-only, like tpu_checkpoint_freq
+    tpu_sweep_checkpoint_freq: int = 0
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

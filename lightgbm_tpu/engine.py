@@ -471,3 +471,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if return_cvbooster:
         results["cvbooster"] = cvbooster
     return dict(results)
+
+
+# many-model sweep training (sweep/): `train`'s fleet sibling,
+# re-exported here so `from lightgbm_tpu.engine import train_many`
+# mirrors `train`. Bottom-of-module import: sweep.trainer reaches back
+# for _seed_from_model lazily, so this line must follow its definition.
+from .sweep import train_many  # noqa: E402,F401  isort:skip

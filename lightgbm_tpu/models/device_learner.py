@@ -1481,6 +1481,33 @@ class DeviceTreeLearner:
             self._cegb_note_record(rec)
         return idxs, rec
 
+    def sweep_build_fn(self, root_padded: int, root_contiguous: bool,
+                       l1, l2, l2c):
+        """Raw (un-jitted) whole-tree build with the split lambdas threaded
+        as traced scalars — the sweep trainer's per-model build lane.
+
+        Must be called INSIDE an active trace (the sweep round program)
+        with `l1`/`l2`/`l2c` tracers: the split finder is rebuilt around a
+        hyper whose lambda fields are those tracers, `_make_build_fn`
+        captures it, and `self.finder` is restored before returning. The
+        raw python body is returned (not the jitted wrapper) so the
+        enable_x64 blocks inside `_build` execute live during the caller's
+        vmap trace — vmapping the cached jitted program re-canonicalizes
+        the f64 reduce inits to f32, which XLA rejects as mixed precision.
+        """
+        hyper_t = self.hyper._replace(lambda_l1=l1, lambda_l2=l2,
+                                      lambda_l2_cat=l2c)
+        old_finder = self.finder
+        self.finder = make_split_finder(hyper_t, self.meta,
+                                        self.max_bin_global)
+        try:
+            # _make_build_fn captures self.finder into a local; restoring
+            # the static finder afterwards does not disturb the closure
+            return self._make_build_fn(root_padded, root_contiguous
+                                       ).__wrapped__
+        finally:
+            self.finder = old_finder
+
     def train_iter_fused(self, score: jax.Array, objective, scale: float,
                          feature_mask: Optional[np.ndarray] = None
                          ) -> Tuple[jax.Array, jax.Array, TreeRecord]:
@@ -1626,9 +1653,11 @@ def _partition_score_update(score, class_id, leaf_begin, leaf_cnt,
     return score.at[class_id].add(scale * delta)
 
 
-@functools.partial(jax.jit, static_argnames=("padded", "f64"))
 def _masked_sums(indices, gh, count, padded: int, f64: bool = False):
-    compile_cache.note_trace()
+    # Deliberately NOT @jax.jit: the only call site is inside `_build`'s
+    # trace, and a nested pjit re-canonicalizes the f64 reduce init to f32
+    # when the enclosing program is vmapped (sweep mode), which XLA rejects
+    # as mixed precision. Inline tracing keeps the enable_x64 block live.
     idx = lax.dynamic_slice(indices, (jnp.int32(0),), (padded,))
     pos = jnp.arange(padded, dtype=jnp.int32)
     valid = pos < count

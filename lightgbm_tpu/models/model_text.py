@@ -39,6 +39,10 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_trace_sample", "tpu_serve_trace_ring", "tpu_serve_slo_ms",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
+    # sweep-trainer infrastructure: the fleet's model bytes must match
+    # the sequential twin's regardless of how the sweep was driven
+    "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
+    "tpu_sweep_checkpoint_freq",
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
     "tpu_dist_devices",
     # how the matrix was ingested does not change what it binned to

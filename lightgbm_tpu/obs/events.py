@@ -63,6 +63,14 @@ EVENTS: Dict[str, str] = {
                              "model version (retried next tick)",
     "serve_watch_error": "checkpoint watcher poll raised; the thread "
                          "survives and retries",
+    # many-model sweep trainer (sweep/)
+    "sweep_init": "train_many chose its execution mode: fleet size, "
+                  "batched vs interleaved, and the gate's fallback "
+                  "reason when batching was rejected",
+    "sweep_refresh": "continual-refresh cycle published the retrained "
+                     "fleet's serving checkpoint versions",
+    "sweep_train": "train_many finished: fleet size, mode, rounds, "
+                   "wall time, trace count",
     # distributed runtime (dist/)
     "dist_init": "distributed runtime activated: tree_learner mode, mesh "
                  "shard count, device kinds",

@@ -60,6 +60,9 @@ TERMS: Dict[str, str] = {
     "quant_pack": "stochastic-rounded gradient quantization pass of "
                   "the quantized-histogram path (per-tree int8/int16 "
                   "pack + scale)",
+    "sweep": "batched fleet round program (all M models' gradients + "
+             "builds + score updates in one dispatch); fenced only on "
+             "trim rounds, where the sweep loop drains anyway",
 }
 
 # _dispatch_device site string -> fenced term. Sites not listed fall
@@ -75,6 +78,7 @@ SITE_TERMS: Dict[str, str] = {
     "eval": "eval",
     "dist.allreduce": "allreduce",
     "round_tail": "other",
+    "sweep.round": "sweep",
 }
 
 # objectives whose gradient pass is the ranking pair term

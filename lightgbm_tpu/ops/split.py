@@ -54,6 +54,10 @@ class SplitHyper(NamedTuple):
     max_cat_threshold: int
     max_cat_to_onehot: int
     min_data_per_group: int
+    # lambda_l2 + cat_l2, precomputed in double so the sorted-categorical
+    # path sees the same rounding whether the lambdas are static floats or
+    # per-model traced scalars (sweep mode threads all three as operands).
+    lambda_l2_cat: float = 0.0
 
     @classmethod
     def from_config(cls, cfg) -> "SplitHyper":
@@ -69,6 +73,7 @@ class SplitHyper(NamedTuple):
             max_cat_threshold=int(cfg.max_cat_threshold),
             max_cat_to_onehot=int(cfg.max_cat_to_onehot),
             min_data_per_group=int(cfg.min_data_per_group),
+            lambda_l2_cat=float(cfg.lambda_l2) + float(cfg.cat_l2),
         )
 
 
@@ -269,7 +274,7 @@ def make_split_finder(hyper: SplitHyper, feature_meta: Dict[str, np.ndarray],
         lc_oh_best = jnp.take_along_axis(c, t_oh[:, None], 1)[:, 0]
 
         # ---- CTR-sorted many-vs-many (:170-240); l2 += cat_l2
-        l2c = h.lambda_l2 + h.cat_l2
+        l2c = h.lambda_l2_cat
         elig = cand & (c >= h.cat_smooth)
         ctr = g / (hs + h.cat_smooth)
         sort_key = jnp.where(elig, ctr, jnp.inf)

@@ -1,0 +1,83 @@
+"""Batched (model-axis) score-update lanes for the sweep trainer.
+
+The sweep round program (``sweep/batched.py``) vmaps one model's whole
+boosting round over a leading model axis. Inside that vmap trace the
+per-model score updates must be the RAW python bodies of the existing
+jitted programs — calling the jitted wrappers re-enters pjit under vmap,
+which re-canonicalizes the f64 reduce-init constants of the hist path to
+f32 (XLA rejects the resulting HLO as mixed precision) and hides the
+``enable_x64`` blocks from the enclosing trace.
+
+This module provides those raw lanes, built from the same ``ops``
+primitives the single-model programs use, so the math is the same
+expression tree and the bitwise-parity contract (batched model text ==
+sequential model text under ``tpu_use_f64_hist``) holds by construction:
+
+- ``partition_score_update_lane`` — the fresh-tree (no bagging) update:
+  leaf fill over the final partition + one key-sort back to row order,
+  mirroring ``device_learner._partition_score_update``.
+- ``record_score_lane`` — the bagged update: record traversal over the
+  full binned matrix (out-of-bag rows also need scores), mirroring
+  ``device_learner.add_record_score``/``add_score``.
+
+Both take the per-model ``scale`` (learning rate) as a traced operand so
+one program covers a learning-rate grid.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .partition import leaf_value_fill, unpermute_to_rows
+
+
+def partition_score_update_lane(score: jax.Array, class_id: int,
+                                leaf_begin: jax.Array, leaf_cnt: jax.Array,
+                                leaf_value: jax.Array, indices: jax.Array,
+                                count, scale) -> jax.Array:
+    """score[class_id] += scale * leaf values scattered via the final
+    partition — the raw body of ``_partition_score_update`` (the fused
+    fresh-tree update), valid only for full-data trees. ``class_id`` is
+    a python int (the per-class loop is unrolled inside the sweep round
+    trace); ``scale`` may be a traced per-model scalar."""
+    n = score.shape[1]
+    # leaf slices all live inside [0, n): fill and sort only that prefix
+    fill = leaf_value_fill(leaf_begin, leaf_cnt, leaf_value, n)
+    delta = unpermute_to_rows(lax.slice(indices, (0,), (n,)), fill,
+                              count, n)
+    return score.at[class_id].add(scale * delta)
+
+
+def record_score_lane(score_row: jax.Array, bins: jax.Array, trav: Dict,
+                      nb, db, mt, scale,
+                      col: Optional[jax.Array] = None,
+                      boff: Optional[jax.Array] = None,
+                      bpk: Optional[jax.Array] = None) -> jax.Array:
+    """score_row += scale * tree(x) via record traversal (raw body of
+    ``add_record_score`` — the bagged-iteration update, covering
+    out-of-bag rows). Imported lazily from models.device_learner to keep
+    ops -> models a call-time edge, not an import-time cycle."""
+    from ..models.device_learner import add_record_score
+    return add_record_score.__wrapped__(score_row, bins, trav, nb, db,
+                                        mt, scale, col, boff, bpk)
+
+
+def stacked_bag_partitions(bag_indices_list, n_pad: int) -> jax.Array:
+    """[M, n_pad] root partitions from M per-model bagging subsets — the
+    model-axis analogue of ``partition.init_partition_from``. Built on
+    host in one shot (one transfer for the whole fleet instead of M
+    eager pad/concat dispatches per round)."""
+    import numpy as np
+    out = np.empty((len(bag_indices_list), n_pad), np.int32)
+    for m, idx in enumerate(bag_indices_list):
+        idx = np.asarray(idx, np.int32)
+        n = idx.shape[0]
+        if n >= n_pad:
+            out[m] = idx[:n_pad]
+        else:
+            out[m, :n] = idx
+            out[m, n:] = idx[-1] if n else 0
+    return jnp.asarray(out)

@@ -58,6 +58,11 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_trace_sample", "tpu_serve_trace_ring", "tpu_serve_slo_ms",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
+    # sweep-trainer infrastructure (sweep/): a fleet checkpoint may be
+    # resumed with different sweep plumbing, and a sequential checkpoint
+    # is mode-independent anyway
+    "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
+    "tpu_sweep_checkpoint_freq",
     # topology: trees are bit-identical across tree_learner / shard-count
     # choices (distributed parity contract), so a checkpoint taken on one
     # topology may resume on another — e.g. a preempted 4-chip run

@@ -1,0 +1,24 @@
+"""Many-model vectorized training (the "fleet" trainer).
+
+``train_many`` trains M boosters against ONE shared binned Dataset in a
+single jitted program per round: the per-round stack (gradients ->
+histogram accumulation -> split evaluation -> partition -> leaf values)
+is vmapped over a leading model axis, with the per-model learning rate,
+split lambdas, bagging subsets and feature masks threaded as traced
+operands so one program covers a whole hyperparameter grid. Configs the
+batched program cannot express fall back to an interleaved round-robin
+of ordinary per-booster round dispatches (the device queue stays full;
+jax dispatch is async).
+
+``refresh_many`` closes the production loop: a continual warm-start
+refresh (``train_many(init_models=...)``) whose per-model serving
+checkpoints the existing serving watcher hot-swaps live.
+
+See docs/Sweep.md for the batching model and the parity contract.
+"""
+from .batched import SWEEP_VARYING, batched_gate, shared_grid_signature
+from .refresh import refresh_many, write_serving_checkpoint
+from .trainer import train_many
+
+__all__ = ["train_many", "refresh_many", "write_serving_checkpoint",
+           "batched_gate", "shared_grid_signature", "SWEEP_VARYING"]

@@ -1,0 +1,219 @@
+"""Batched sweep mode: one jitted round program for M boosters.
+
+The round program is ``jit(vmap(one_model))`` over a leading model axis,
+where ``one_model`` is the RAW python body of one booster's fused round:
+objective gradients -> per-class whole-tree build (via
+``DeviceTreeLearner.sweep_build_fn``, which threads the split lambdas as
+traced scalars) -> score update (partition fill for fresh trees, record
+traversal for bagged ones, both from ``ops.sweep_ops``). Raw bodies are
+mandatory: vmapping the registered jitted programs re-canonicalizes
+their f64 reduce-init constants to f32 under the global x64-off config,
+which XLA rejects as mixed precision — the raw bodies keep the
+``enable_x64`` blocks live during the vmap trace, so the batched math is
+the exact expression tree the sequential programs trace, and model text
+stays byte-equal per booster under ``tpu_use_f64_hist``.
+
+Registry discipline: the program enters the process-wide compile cache
+keyed by the learner/objective trace signatures with the swept fields
+normalized out, so model #2..M cost zero traces by construction (one
+program) and a SECOND fleet at the same shapes — any grid — costs zero
+traces too (asserted by tests/test_sweep.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import compile_cache
+from ..models.device_learner import (DeviceTreeLearner, _pow2ceil,
+                                     traversal_arrays)
+from ..ops.sweep_ops import (partition_score_update_lane,
+                             record_score_lane)
+
+# Config fields the batched program may vary PER MODEL (everything else
+# must be equal across the fleet — they are traced operands or host-side
+# schedule inputs, never trace constants):
+#   learning_rate            -> score-update scale operand
+#   lambda_l1/lambda_l2      -> split-finder operands (sweep_build_fn)
+#   bagging_seed/bagging_freq-> host RNG schedule; bag partitions are
+#                               per-model index operands
+#   feature_fraction_seed    -> host RNG; masks are per-model operands
+SWEEP_VARYING = frozenset({
+    "learning_rate", "lambda_l1", "lambda_l2",
+    "bagging_seed", "bagging_freq", "feature_fraction_seed",
+})
+
+# The sweep trainer's own knobs: runtime infrastructure, never part of
+# the training math (also excluded from model text / checkpoint
+# signatures — see models/model_text.py, resilience/checkpoint.py).
+SWEEP_RUNTIME = frozenset({
+    "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
+    "tpu_sweep_checkpoint_freq",
+})
+
+_NORM = "<swept>"
+
+
+def _normalized_config_items(cfg) -> Tuple:
+    """``config_signature`` with swept + sweep-runtime fields pinned to a
+    sentinel: the grid-independent part of a model's config."""
+    return tuple(
+        (k, _NORM if (k in SWEEP_VARYING or k in SWEEP_RUNTIME) else v)
+        for k, v in compile_cache.config_signature(cfg))
+
+
+def shared_grid_signature(cfg) -> Tuple:
+    """The config signature every fleet member must share for batched
+    mode (grid fields and sweep-runtime knobs normalized out)."""
+    return _normalized_config_items(cfg)
+
+
+def _normalized_learner_sig(learner) -> Tuple:
+    """Learner trace signature with the swept config fields normalized —
+    the registry key part that makes two fleets with different grids hit
+    the same program."""
+    raw_cfg = compile_cache.config_signature(learner.cfg)
+    norm_cfg = _normalized_config_items(learner.cfg)
+    return tuple(norm_cfg if item == raw_cfg else item
+                 for item in learner.trace_signature())
+
+
+def batched_gate(gbdts, cfgs) -> Optional[str]:
+    """None when the fleet can train in batched mode; else the first
+    failing reason (the trainer then runs the interleaved fallback).
+
+    The gate admits exactly the configs whose sequential twin takes the
+    leaf-wise ``_train_one_iter_fused`` path with uniform shapes across
+    models — what the vmapped round program replicates bit-for-bit."""
+    from ..models.gbdt import GBDT
+    from ..ops.objectives import ObjectiveFunction
+    g0 = gbdts[0]
+    cfg0 = cfgs[0]
+    if type(g0) is not GBDT:
+        return f"boosting type {type(g0).__name__} (DART/GOSS/RF reshape " \
+               "scores or sampling host-side)"
+    if not g0.use_fused or type(g0.learner) is not DeviceTreeLearner:
+        return "fleet needs the single-device fused learner"
+    if cfg0.tpu_grow_mode not in ("leafwise", "auto"):
+        return f"tpu_grow_mode={cfg0.tpu_grow_mode!r} (the batched round " \
+               "replicates the leaf-wise fused path; set 'leafwise')"
+    if cfg0.tpu_grow_mode == "auto" \
+            and g0.learner.aligned_mode_ok(g0.objective):
+        return "tpu_grow_mode=auto resolves to the aligned pipeline " \
+               "here; set 'leafwise' to batch the fleet"
+    if cfg0.tpu_fuse_iteration:
+        return "tpu_fuse_iteration routes to the mega-fused single-model " \
+               "program"
+    if g0.objective is None:
+        return "custom-objective training has no device gradient program"
+    if type(g0.objective).get_gradients is not ObjectiveFunction.get_gradients:
+        return f"objective {g0.objective.name!r} composes gradients " \
+               "host-side"
+    if getattr(g0.objective, "is_renew_tree_output", False):
+        return "renew-tree-output objectives rewrite leaves host-side"
+    if not all(g0._class_need_train) or g0.train_data.num_features == 0:
+        return "constant-class iterations need the host constant-tree path"
+    if getattr(g0.learner, "quant_bits", 0):
+        return "quantized-histogram path threads a host qseq counter"
+    if cfg0.sequential_device_only:
+        return "forced splits / CEGB depend on host commit order"
+    if g0._balanced_bagging:
+        return "balanced bagging draws per-class counts (non-uniform " \
+               "partition shapes)"
+    base = shared_grid_signature(cfg0)
+    for m, cfg in enumerate(cfgs[1:], start=1):
+        if shared_grid_signature(cfg) != base:
+            diff = [k for (k, a), (_, b) in
+                    zip(shared_grid_signature(cfg), base) if a != b]
+            return f"model {m} differs outside the sweep grid: {diff[:4]}"
+    bag0 = gbdts[0]._will_bag()
+    if any(g._will_bag() != bag0 for g in gbdts):
+        return "mixed bagged/unbagged fleet (bagging_fraction uniform " \
+               "with varying freq/seed is supported)"
+    return None
+
+
+def make_round_program(learner: DeviceTreeLearner, objective,
+                       M: int, K: int, num_leaves: int,
+                       bagged: bool, bag_cnt: int):
+    """The fleet's per-round program ``fn(scores, fmasks, lr, l1, l2,
+    l2c[, idx, bc], bins, bins_T) -> (scores', (rec_0..rec_{K-1}))``,
+    registered process-wide.
+
+    Operand shapes: scores [M, K, N] (donated), fmasks [M, K, F] f32,
+    lr/l1/l2/l2c [M] f32, idx [M, n_pad] int32 + bc [M] int32 (bagged
+    only). Returned records are TreeRecords with a leading model axis.
+    """
+    n = learner.n
+    root_count = bag_cnt if bagged else n
+    root_padded = max(_pow2ceil(root_count), learner.min_pad)
+    key = ("sweep_round", M, K, bagged, root_padded,
+           _normalized_learner_sig(learner), objective.trace_signature())
+
+    def factory():
+        Lm1 = max(num_leaves - 1, 1)
+        nb, db, mt = learner._nb_dev, learner._db_dev, learner._mt_dev
+        bundled = getattr(learner, "bundled", False)
+        col = learner._col_dev if bundled else None
+        boff = learner._boff_dev if bundled else None
+        bpk = learner._bpk_dev if bundled else None
+
+        def classes(score, fmask, lr, l1, l2, l2c, bins, bins_T,
+                    idx=None, bc=None):
+            """One model's full round: gradients once (pre-update score,
+            like the sequential round), then the per-class build +
+            score-update chain in class order."""
+            compile_cache.note_trace()
+            g, h = objective.gradients_impl(score)
+            recs = []
+            new_score = score
+            for k in range(K):
+                build = learner.sweep_build_fn(root_padded, not bagged,
+                                               l1, l2, l2c)
+                if bagged:
+                    idxs, rec = build(bins, bins_T, idx, g[k], h[k], bc,
+                                      fmask[k])
+                    # out-of-bag rows also need scores -> traversal
+                    trav = traversal_arrays.__wrapped__(rec, Lm1)
+                    new_score = new_score.at[k].set(record_score_lane(
+                        new_score[k], bins, trav, nb, db, mt, lr,
+                        col, boff, bpk))
+                else:
+                    idxs, rec = build(bins, bins_T, g[k], h[k], fmask[k])
+                    new_score = partition_score_update_lane(
+                        new_score, k, rec.leaf_begin, rec.leaf_cnt_part,
+                        rec.leaf_value, idxs, jnp.int32(n), lr)
+                recs.append(rec)
+            return new_score, tuple(recs)
+
+        if bagged:
+            def one_model(score, fmask, lr, l1, l2, l2c, idx, bc,
+                          bins, bins_T):
+                return classes(score, fmask, lr, l1, l2, l2c, bins,
+                               bins_T, idx=idx, bc=bc)
+            axes = (0, 0, 0, 0, 0, 0, 0, 0, None, None)
+        else:
+            def one_model(score, fmask, lr, l1, l2, l2c, bins, bins_T):
+                return classes(score, fmask, lr, l1, l2, l2c, bins,
+                               bins_T)
+            axes = (0, 0, 0, 0, 0, 0, None, None)
+        return jax.jit(jax.vmap(one_model, in_axes=axes),
+                       donate_argnums=(0,))
+
+    return compile_cache.program(key, factory), key
+
+
+def lambda_operands(cfgs) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-model (l1, l2, l2 + cat_l2) f32 operand vectors. The cat sum
+    is computed in HOST DOUBLE per model — the same rounding the static
+    ``SplitHyper.from_config`` path bakes in (split.py lambda_l2_cat),
+    so sorted-categorical gains match the sequential twin bitwise."""
+    l1 = np.asarray([np.float32(c.lambda_l1) for c in cfgs], np.float32)
+    l2 = np.asarray([np.float32(c.lambda_l2) for c in cfgs], np.float32)
+    l2c = np.asarray(
+        [np.float32(float(c.lambda_l2) + float(c.cat_l2)) for c in cfgs],
+        np.float32)
+    return l1, l2, l2c

@@ -1,0 +1,85 @@
+"""Continual fleet refresh: warm-start retrain -> serving hot-swap.
+
+The production loop the sweep trainer closes: a fleet of live models is
+periodically retrained (``train_many(init_models=...)`` — every member
+warm-starts from its currently-served predecessor) and each refreshed
+model is published as a serving checkpoint the serving plane already
+knows how to consume. ``write_serving_checkpoint`` emits exactly the
+layout ``serving.registry.load_checkpoint_model_text`` reads — a
+``MANIFEST.json {"latest": ...}`` pointer next to versioned
+``ckpt_NNNNNN/model.txt`` dirs, manifest written LAST so a concurrent
+watcher poll can never observe a torn model — which means the existing
+``serving.watcher`` hot-swaps the refreshed fleet live with no new
+serving-side code.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..basic import Booster, Dataset, LightGBMError
+from ..utils import log
+from .trainer import train_many
+
+__all__ = ["refresh_many", "write_serving_checkpoint"]
+
+
+def write_serving_checkpoint(directory: str, model_text: str) -> str:
+    """Publish one model text as the next serving checkpoint version in
+    ``directory``; returns the version name (``ckpt_NNNNNN``).
+
+    Versions continue from the directory's manifest (fresh dirs start
+    at ``ckpt_000001``). The model file is written atomically first and
+    the manifest pointer flipped after, so readers polling through
+    ``load_checkpoint_model_text`` see either the old complete version
+    or the new complete version, never a partial write."""
+    from ..resilience.checkpoint import (MANIFEST_NAME, atomic_write_text,
+                                         read_manifest)
+    man = read_manifest(directory)
+    version = 0
+    if man is not None:
+        latest = str(man.get("latest") or "")
+        tail = latest.rsplit("_", 1)[-1]
+        if tail.isdigit():
+            version = int(tail)
+    name = f"ckpt_{version + 1:06d}"
+    atomic_write_text(os.path.join(directory, name, "model.txt"),
+                      model_text)
+    atomic_write_text(os.path.join(directory, MANIFEST_NAME),
+                      json.dumps({"latest": name}))
+    return name
+
+
+def refresh_many(params_list: Sequence[Dict[str, Any]],
+                 train_set: Dataset, serve_dirs: Sequence[str],
+                 num_boost_round: int = 100,
+                 init_models: Optional[Sequence[
+                     Union[str, Booster, None]]] = None) -> List[Booster]:
+    """One refresh cycle for a served fleet.
+
+    ``serve_dirs[m]`` is model m's serving checkpoint directory (what a
+    ``serving.watcher`` entry polls). When ``init_models`` is None the
+    warm starts are read from those directories' CURRENT versions —
+    the continual-learning default: each cycle extends the trees being
+    served right now. Members whose directory is still empty train from
+    scratch. Returns the refreshed Boosters after publishing each as
+    its directory's next version."""
+    if len(serve_dirs) != len(params_list):
+        raise LightGBMError("refresh_many needs one serve_dir per model")
+    if init_models is None:
+        from ..serving.registry import load_checkpoint_model_text
+        seeds: List[Optional[Booster]] = []
+        for d in serve_dirs:
+            cur = load_checkpoint_model_text(d)
+            seeds.append(None if cur is None
+                         else Booster(model_str=cur[0]))
+        init_models = seeds
+    boosters = train_many(params_list, train_set, num_boost_round,
+                          init_models=init_models)
+    versions = []
+    for bst, d in zip(boosters, serve_dirs):
+        versions.append(write_serving_checkpoint(d, bst.model_to_string()))
+    log.event("sweep_refresh", models=len(boosters),
+              rounds=int(num_boost_round), versions=versions)
+    return boosters

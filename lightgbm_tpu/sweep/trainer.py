@@ -1,0 +1,508 @@
+"""The fleet trainer: M boosters, one shared Dataset, one jitted round.
+
+``train_many`` is ``engine.train``'s many-model sibling. M "probe"
+Boosters are constructed exactly like sequential training boosters —
+they own the per-model Config, the host RNG streams (bagging / feature
+fraction), warm-start trees, and the round-0 ``boost_from_average``
+mutation — but in batched mode they never dispatch a training program.
+One registered round program (``sweep/batched.py``) advances ALL M
+score planes ``[M, K, N]`` per round, with the per-model learning rate,
+split lambdas, bagging partitions, and feature masks threaded as traced
+operands; the batched TreeRecords land in a central device log and
+``probe._gbdt.models`` holds lightweight ``_RecRef`` entries into it.
+Because the refs live in the probe's own model list, the sequential
+bookkeeping applies to the fleet unchanged: ``boost_from_average``'s
+empty-models gate closes after round 0, warm-start prepends stay ahead
+of new trees, and the 16-round deferred trailing-empty trim deletes
+from the same list with the same arithmetic. Export is ONE device_get
+of the whole log followed by the same model-string round-trip
+``engine.train`` performs.
+
+Parity contract: under ``tpu_use_f64_hist`` the model text of fleet
+member m is byte-equal to ``engine.train`` with the same params
+(tests/test_sweep.py asserts it for plain / bagged / multiclass).
+
+Configs the batched gate rejects fall back to INTERLEAVED mode: the
+probes train for real, one round each in round-robin order, so the
+async dispatch queue stays full across models while per-model programs
+keep their own shapes. Both modes share the fleet checkpoint format
+(``tpu_sweep_checkpoint_dir`` / ``tpu_sweep_checkpoint_freq``): model
+texts + score planes + host RNG + pending trim counters per model, so a
+preempted sweep resumes bitwise on either path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import compile_cache
+from ..basic import Booster, Dataset, LightGBMError
+from ..utils import log
+from .batched import batched_gate, lambda_operands, make_round_program
+
+_FLEET_SCHEMA = 1
+
+# trainer-level aliases engine.train also honors (reference sklearn.py
+# alias table); they must not leak into Config.from_params
+_ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
+                  "n_iter", "num_tree", "num_trees", "num_round",
+                  "num_rounds", "n_estimators")
+
+
+class _RecRef:
+    """A fleet tree still on device: an index into the central record
+    log (one ``[M]``-leading TreeRecord tuple per round) plus the
+    per-model shrinkage/bias — the model-axis analogue of
+    ``gbdt.LazyTree``. Lives in ``probe._gbdt.models`` so the
+    sequential bookkeeping (boost_from_average gating, warm-start
+    prepends, trailing-empty trim) applies unchanged."""
+
+    __slots__ = ("entry", "k", "shrinkage", "bias")
+
+    def __init__(self, entry: int, k: int, shrinkage: float,
+                 bias: float) -> None:
+        self.entry = entry
+        self.k = k
+        self.shrinkage = shrinkage
+        self.bias = bias
+
+
+class _Fleet:
+    """Batched-run device state; also the HBM-accountant owner for the
+    stacked score buffer (obs/memory.py ``sweep/scores``)."""
+
+    def __init__(self, scores: jax.Array) -> None:
+        self.scores = scores          # [M, K, N] f32, donated per round
+        self.rec_log: List[Tuple] = []  # one K-tuple of batched recs/round
+
+
+def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
+               num_boost_round: int = 100,
+               init_models: Optional[Sequence[
+                   Union[str, Booster, None]]] = None) -> List[Booster]:
+    """Train ``len(params_list)`` boosters against one shared Dataset.
+
+    Every params dict may vary the sweep grid fields
+    (``sweep.SWEEP_VARYING``: learning_rate, lambda_l1/l2, bagging seed
+    and freq, feature_fraction_seed) freely; everything else must agree
+    across the fleet for batched mode — ``tpu_sweep_mode="auto"`` falls
+    back to the interleaved path otherwise, ``"batched"`` raises with
+    the gate's reason. ``init_models`` (per-model Booster / model file /
+    None) warm-starts members like ``engine.train(init_model=...)``;
+    it is ignored when resuming from ``tpu_sweep_checkpoint_dir`` (the
+    checkpointed texts already contain the seed trees). Returns M
+    independent Boosters round-tripped through their model strings,
+    exactly like ``engine.train``.
+    """
+    if not params_list:
+        raise LightGBMError("train_many needs at least one params dict")
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    t_start = time.perf_counter()
+    traces0 = compile_cache.trace_count()
+
+    probes: List[Booster] = []
+    clean_params: List[Dict[str, Any]] = []
+    for params in params_list:
+        params = dict(params)
+        for alias in _ROUND_ALIASES:
+            if alias in params:
+                num_boost_round = int(params.pop(alias))
+        train_set._update_params(params)
+        clean_params.append(params)
+        probes.append(Booster(params=params, train_set=train_set))
+    gbdts = [b._gbdt for b in probes]
+    cfgs = [b._cfg for b in probes]
+    cfg0 = cfgs[0]
+    M = len(probes)
+
+    ledger = None
+    if cfg0.tpu_trace:
+        from ..obs import ledger as obs_ledger
+        tdir = cfg0.tpu_trace_dir or "lgbt_trace"
+        ledger = obs_ledger.RoundLedger.for_training(tdir, cfg0)
+
+    ckpt_dir = cfg0.tpu_sweep_checkpoint_dir
+    loaded = _fleet_ckpt_load(ckpt_dir) if ckpt_dir else None
+    if loaded is not None and int(loaded[0]["models"]) != M:
+        raise LightGBMError(
+            f"sweep resume: checkpoint holds {loaded[0]['models']} models, "
+            f"fleet has {M}")
+
+    if init_models is not None and loaded is None:
+        if len(init_models) != M:
+            raise LightGBMError("init_models must have one entry per model")
+        from ..engine import _seed_from_model
+        for probe, init in zip(probes, init_models):
+            if init is None:
+                continue
+            ib = Booster(model_file=init) if isinstance(init, str) else init
+            _seed_from_model(probe, ib)
+
+    mode = (cfg0.tpu_sweep_mode or "auto").lower()
+    if mode not in ("auto", "batched", "interleaved"):
+        raise LightGBMError(f"unknown tpu_sweep_mode={mode!r}")
+    reason = batched_gate(gbdts, cfgs)
+    if mode == "batched" and reason is not None:
+        raise LightGBMError(f"tpu_sweep_mode=batched rejected: {reason}")
+    use_batched = mode != "interleaved" and reason is None
+    chosen = "batched" if use_batched else "interleaved"
+    if loaded is not None and loaded[0].get("mode") != chosen:
+        raise LightGBMError(
+            f"sweep resume: checkpoint was written in "
+            f"{loaded[0].get('mode')!r} mode, this run chose {chosen!r}")
+
+    fields: Dict[str, Any] = {"models": M, "mode": chosen,
+                              "rounds": int(num_boost_round)}
+    if not use_batched and reason is not None:
+        fields["fallback_reason"] = reason
+    log.event("sweep_init", **fields)
+    if ledger is not None:
+        ledger.commit({"kind": "note", "note": "sweep_init", **fields})
+
+    try:
+        if use_batched:
+            out = _train_batched(probes, gbdts, cfgs, clean_params,
+                                 int(num_boost_round), ledger, loaded)
+        else:
+            out = _train_interleaved(probes, gbdts, cfgs, clean_params,
+                                     int(num_boost_round), loaded)
+    finally:
+        if ledger is not None:
+            ledger.close()
+    if ledger is not None:
+        for bst in out:
+            # same carry engine.train does: the ledger lives on the
+            # training probes, which the fresh boosters no longer hold
+            bst._telemetry = ledger
+    log.event("sweep_train", models=M, mode=chosen,
+              rounds=int(num_boost_round),
+              wall_s=round(time.perf_counter() - t_start, 3),
+              traces=compile_cache.trace_count() - traces0)
+    return out
+
+
+# ----------------------------------------------------------------------
+# batched path
+# ----------------------------------------------------------------------
+
+def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
+                   ledger, loaded) -> List[Booster]:
+    from ..models.device_learner import _pow2ceil
+    from ..obs import memory as obs_memory
+    from ..ops.sweep_ops import stacked_bag_partitions
+    g0 = gbdts[0]
+    lrn = g0.learner
+    cfg0 = cfgs[0]
+    M, K, F = len(probes), g0.num_tree_per_iteration, lrn.num_features
+    bagged = g0._will_bag()
+    bag_cnt = int(cfg0.bagging_fraction * g0.num_data) if bagged \
+        else g0.num_data
+    fn, _key = make_round_program(lrn, g0.objective, M, K,
+                                  cfg0.num_leaves, bagged, bag_cnt)
+
+    start_round = 0
+    iters = [0] * M
+    pending: List[Any] = []     # one [M] num_splits vector per (round, k)
+    biases = [[0.0] * K for _ in range(M)]
+    first_fresh = loaded is None
+    if loaded is not None:
+        state, texts, arrays = loaded
+        start_round = _fleet_resume(state, texts, arrays, gbdts, cfgs)
+        iters = [int(x) for x in state["iters"]]
+        per_model = state["pending"]
+        depth = len(per_model[0]) if per_model and per_model[0] else 0
+        pending = [np.asarray([int(per_model[m][i]) for m in range(M)],
+                              np.int32) for i in range(depth)]
+    else:
+        # round-0 init exactly like the sequential loop head: the gate
+        # self-closes once the refs land in probe.models
+        for m, g in enumerate(gbdts):
+            for k in range(K):
+                biases[m][k] = g.boost_from_average(k)
+
+    fleet = _Fleet(jnp.stack([g.train_score.score for g in gbdts]))
+    for g in gbdts:
+        # the fleet buffer owns the training scores now; drop the
+        # per-probe planes so HBM holds one fleet copy, not two
+        g.train_score.score = g.train_score.score[:, :0]
+    obs_memory.track("sweep/scores", fleet,
+                     lambda fl: int(fl.scores.nbytes))
+
+    LR = jnp.asarray([np.float32(g.shrinkage_rate) for g in gbdts],
+                     jnp.float32)
+    l1, l2, l2c = lambda_operands(cfgs)
+    L1, L2, L2C = jnp.asarray(l1), jnp.asarray(l2), jnp.asarray(l2c)
+    bins, bins_T = lrn.bins_dev, lrn.bins_T_dev
+    idx_pad = lrn.n + max(_pow2ceil(lrn.n), lrn.min_pad)
+    ckpt_freq = int(cfg0.tpu_sweep_checkpoint_freq or 0)
+
+    for r in range(start_round, num_boost_round):
+        rnd_iters = list(iters)
+        traces_before = compile_cache.trace_count()
+        t0 = time.perf_counter()
+        if bagged:
+            # host RNG schedule in sequential order: bag redraw first,
+            # then the per-class feature masks (\_train_one_iter_impl)
+            for m, g in enumerate(gbdts):
+                g._bagging(iters[m])
+            IDX = stacked_bag_partitions(
+                [g.bag_data_indices for g in gbdts], idx_pad)
+            BC = jnp.asarray([int(g.bag_data_cnt) for g in gbdts],
+                             jnp.int32)
+        FM = np.empty((M, K, F), np.float32)
+        for m, g in enumerate(gbdts):
+            for k in range(K):
+                fm = g.learner.feature_mask()
+                FM[m, k, :] = 1.0 if fm is None \
+                    else fm.astype(np.float32)
+        if bagged:
+            fleet.scores, recs = fn(fleet.scores, jnp.asarray(FM), LR,
+                                    L1, L2, L2C, IDX, BC, bins, bins_T)
+        else:
+            fleet.scores, recs = fn(fleet.scores, jnp.asarray(FM), LR,
+                                    L1, L2, L2C, bins, bins_T)
+        fleet.rec_log.append(recs)
+        entry = len(fleet.rec_log) - 1
+        for m, g in enumerate(gbdts):
+            for k in range(K):
+                g.models.append(_RecRef(
+                    entry, k, float(g.shrinkage_rate),
+                    biases[m][k] if first_fresh else 0.0))
+            iters[m] += 1
+        first_fresh = False
+        for k in range(K):
+            pending.append(recs[k].num_splits)
+        t_host = time.perf_counter()
+
+        fenced = False
+        if len(pending) >= 16 * K:
+            # deferred trailing-empty trim, per model (the same batched
+            # pull + arithmetic as gbdt._trim_trailing_empty)
+            ns = [np.asarray(x) for x in jax.device_get(pending)]
+            pending = []
+            fenced = True
+            for m, g in enumerate(gbdts):
+                col = [int(x[m]) for x in ns]
+                empty_trailing = 0
+                for it in range(len(col) // K - 1, -1, -1):
+                    if max(col[it * K:(it + 1) * K]) == 0:
+                        empty_trailing += 1
+                    else:
+                        break
+                if empty_trailing and len(g.models) > K:
+                    drop = min(empty_trailing * K, len(g.models) - K)
+                    del g.models[-drop:]
+                    iters[m] -= drop // K
+        t1 = time.perf_counter()
+
+        if ledger is not None:
+            wall = round((t1 - t0) * 1e3, 3)
+            dev = round((t1 - t_host) * 1e3, 3) if fenced else 0.0
+            traces_delta = compile_cache.trace_count() - traces_before
+            for m, g in enumerate(gbdts):
+                rec = {"kind": "round", "round": rnd_iters[m],
+                       "wall_ms": wall, "device_ms": dev,
+                       "traces": traces_delta if m == 0 else 0,
+                       "path": "sweep", "aligned": False, "fallbacks": 0,
+                       "trees": len(g.models), "model": m,
+                       "bag_cnt": int(g.bag_data_cnt) if bagged
+                       else int(g0.num_data)}
+                if fenced:
+                    rec["timing"] = "fenced"
+                    rec["terms_ms"] = {"sweep": dev}
+                ledger.commit(rec)
+
+        if ckpt_freq > 0 and cfg0.tpu_sweep_checkpoint_dir \
+                and (r + 1) % ckpt_freq == 0:
+            _write_batched_ckpt(cfg0.tpu_sweep_checkpoint_dir, r + 1,
+                                probes, gbdts, cfgs, iters, pending,
+                                fleet)
+
+    # ONE device pull for every logged record, then the sequential
+    # export path per model
+    trees_per_model = _materialize_fleet(gbdts, fleet.rec_log)
+    scores_nbytes = int(fleet.scores.nbytes)
+    out = []
+    for m, (probe, g) in enumerate(zip(probes, gbdts)):
+        g.models = trees_per_model[m]
+        g.iter = iters[m]
+        g._pending_numsplits = []
+        g.train_score.score = fleet.scores[m]
+        bst = _package(probe, clean_params[m])
+        # the fleet (and its sweep/scores HBM owner row) dies with this
+        # frame; the stack size survives on the outputs for bench
+        bst._sweep_scores_bytes = scores_nbytes
+        out.append(bst)
+    return out
+
+
+def _materialize_fleet(gbdts, rec_log) -> List[List[Any]]:
+    """Resolve every _RecRef in every probe's model list to a host Tree
+    with one batched device->host transfer of the whole record log."""
+    host_log = jax.device_get(rec_log) if rec_log else []
+    from ..models.gbdt import K_EPSILON
+    out = []
+    for m, g in enumerate(gbdts):
+        trees = []
+        for entry in g.models:
+            if isinstance(entry, _RecRef):
+                rec = host_log[entry.entry][entry.k]
+                rec_m = jax.tree_util.tree_map(lambda a: a[m], rec)
+                tree = g.learner.record_to_tree(rec_m, entry.shrinkage)
+                if abs(entry.bias) > K_EPSILON:
+                    tree.add_bias(entry.bias)
+                trees.append(tree)
+            else:
+                trees.append(entry)
+        out.append(trees)
+    return out
+
+
+def _package(probe: Booster, params: Dict[str, Any]) -> Booster:
+    """engine.train's final round-trip: model string -> fresh Booster."""
+    fresh = Booster(model_str=probe.model_to_string())
+    fresh.params = dict(params)
+    return fresh
+
+
+# ----------------------------------------------------------------------
+# interleaved fallback
+# ----------------------------------------------------------------------
+
+def _train_interleaved(probes, gbdts, cfgs, clean_params, num_boost_round,
+                       loaded) -> List[Booster]:
+    cfg0 = cfgs[0]
+    start_round = 0
+    if loaded is not None:
+        state, texts, arrays = loaded
+        start_round = _fleet_resume(state, texts, arrays, gbdts, cfgs)
+        for m, g in enumerate(gbdts):
+            g.iter = int(state["iters"][m])
+            g._pending_numsplits = [int(x) for x in state["pending"][m]]
+    ckpt_freq = int(cfg0.tpu_sweep_checkpoint_freq or 0)
+    for r in range(start_round, num_boost_round):
+        # round-robin one round per model: jax dispatch is async, so
+        # model m+1's host work overlaps model m's device work
+        for probe in probes:
+            probe.update()
+        if ckpt_freq > 0 and cfg0.tpu_sweep_checkpoint_dir \
+                and (r + 1) % ckpt_freq == 0:
+            texts = [p.model_to_string() for p in probes]
+            scores = jnp.stack([g.train_score.score for g in gbdts])
+            pend = [[int(x) for x in
+                     jax.device_get(list(g._pending_numsplits))]
+                    for g in gbdts]
+            _fleet_ckpt_write(cfg0.tpu_sweep_checkpoint_dir, r + 1,
+                              gbdts, cfgs, [g.iter for g in gbdts],
+                              pend, scores, "interleaved", texts)
+    return [_package(p, params)
+            for p, params in zip(probes, clean_params)]
+
+
+# ----------------------------------------------------------------------
+# fleet checkpoint (shared by both modes)
+# ----------------------------------------------------------------------
+
+def _write_batched_ckpt(directory, round_next, probes, gbdts, cfgs,
+                        iters, pending, fleet) -> None:
+    """Snapshot mid-sweep batched state. Trees are materialized into
+    COPIES (the live _RecRef entries stay untouched) and serialized per
+    model; pending trim counters are pulled but NOT cleared, so the
+    trim cadence after resume matches the uninterrupted run."""
+    trees_per_model = _materialize_fleet(gbdts, fleet.rec_log)
+    texts = []
+    for probe, g, trees in zip(probes, gbdts, trees_per_model):
+        live = g.models
+        g.models = trees
+        try:
+            texts.append(probe.model_to_string())
+        finally:
+            g.models = live
+    ns = [np.asarray(x) for x in jax.device_get(list(pending))]
+    pend = [[int(x[m]) for x in ns] for m in range(len(gbdts))]
+    _fleet_ckpt_write(directory, round_next, gbdts, cfgs, iters, pend,
+                      fleet.scores, "batched", texts)
+
+
+def _fleet_ckpt_write(directory, round_next, gbdts, cfgs, iters, pend,
+                      scores, mode, texts) -> None:
+    from ..resilience.checkpoint import (MANIFEST_NAME, atomic_write_text,
+                                         capture_rng_states,
+                                         training_signature)
+    name = f"ckpt_{round_next:06d}"
+    cdir = os.path.join(directory, name)
+    os.makedirs(cdir, exist_ok=True)
+    for m, text in enumerate(texts):
+        atomic_write_text(os.path.join(cdir, f"model_{m:02d}.txt"), text)
+    arrays = {"scores": np.asarray(jax.device_get(scores), np.float32)}
+    if gbdts[0].bag_data_indices is not None:
+        arrays["bag_indices"] = np.stack(
+            [np.asarray(g.bag_data_indices, np.int32) for g in gbdts])
+        arrays["bag_cnt"] = np.asarray(
+            [int(g.bag_data_cnt) for g in gbdts], np.int32)
+    tmp = os.path.join(cdir, ".arrays.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, os.path.join(cdir, "arrays.npz"))
+    state = {"sweep_schema": _FLEET_SCHEMA, "round": int(round_next),
+             "mode": mode, "models": len(gbdts),
+             "iters": [int(x) for x in iters],
+             "pending": pend,
+             "rng": [capture_rng_states(g) for g in gbdts],
+             "signatures": [training_signature(cfg) for cfg in cfgs]}
+    atomic_write_text(os.path.join(cdir, "state.json"),
+                      json.dumps(state, sort_keys=True))
+    # manifest last: readers only ever see complete checkpoints
+    atomic_write_text(os.path.join(directory, MANIFEST_NAME),
+                      json.dumps({"latest": name, "kind": "sweep_fleet",
+                                  "models": len(gbdts)}))
+
+
+def _fleet_ckpt_load(directory):
+    """(state, texts, arrays) of the latest fleet checkpoint, or None."""
+    from ..resilience.checkpoint import read_manifest
+    man = read_manifest(directory)
+    if man is None:
+        return None
+    cdir = os.path.join(directory, str(man["latest"]))
+    with open(os.path.join(cdir, "state.json")) as f:
+        state = json.load(f)
+    if int(state.get("sweep_schema", -1)) != _FLEET_SCHEMA:
+        raise LightGBMError(
+            f"sweep resume: unknown checkpoint schema in {cdir}")
+    texts = []
+    for m in range(int(state["models"])):
+        with open(os.path.join(cdir, f"model_{m:02d}.txt")) as f:
+            texts.append(f.read())
+    arrays = dict(np.load(os.path.join(cdir, "arrays.npz")))
+    return state, texts, arrays
+
+
+def _fleet_resume(state, texts, arrays, gbdts, cfgs) -> int:
+    """Install checkpointed per-model state onto the probe GBDTs; the
+    caller restores mode-specific extras (iters/pending). Returns the
+    round index to continue from."""
+    from ..resilience.checkpoint import (install_rng_states,
+                                         training_signature)
+    for m, cfg in enumerate(cfgs):
+        if state["signatures"][m] != training_signature(cfg):
+            raise LightGBMError(
+                f"sweep resume: model {m}'s config no longer matches the "
+                "checkpoint's training signature")
+    scores = arrays["scores"]
+    for m, g in enumerate(gbdts):
+        g.models = list(Booster(model_str=texts[m]).trees)
+        g.train_score.score = jnp.asarray(scores[m])
+        install_rng_states(g, state["rng"][m])
+        if "bag_indices" in arrays:
+            g.bag_data_indices = np.asarray(arrays["bag_indices"][m],
+                                            np.int32)
+            g.bag_data_cnt = int(arrays["bag_cnt"][m])
+    return int(state["round"])

@@ -1,0 +1,265 @@
+"""Many-model sweep trainer (`lightgbm_tpu.sweep`): batched fleet vs
+sequential byte-equality under tpu_use_f64_hist, zero-retrace discipline
+for later models and later fleets, fleet checkpoint/resume, interleaved
+fallback parity, gate behavior, and the serving refresh loop.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import compile_cache
+from lightgbm_tpu.sweep import (SWEEP_VARYING, batched_gate, refresh_many,
+                                shared_grid_signature, train_many,
+                                write_serving_checkpoint)
+
+BASE = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+        "tpu_use_f64_hist": True, "tpu_grow_mode": "leafwise",
+        "verbosity": -1}
+
+
+def _data(seed=7, n=400, f=12):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, f // 2] - X[:, f - 1]
+         + rng.rand(n) * 0.1).astype(np.float32)
+    return X, y
+
+
+def _texts(boosters):
+    return [b.model_to_string() for b in boosters]
+
+
+def _seq_texts(grids, X, y, rounds):
+    return [lgb.train(dict(p), lgb.Dataset(X, label=y),
+                      num_boost_round=rounds).model_to_string()
+            for p in grids]
+
+
+# ----------------------------------------------------------------------
+# byte-equality: batched fleet == sequential twins
+# ----------------------------------------------------------------------
+
+def test_batched_plain_byte_equal():
+    X, y = _data()
+    grids = [dict(BASE, learning_rate=lr, lambda_l2=l2)
+             for lr, l2 in [(0.1, 0.0), (0.05, 1.0), (0.2, 0.5),
+                            (0.3, 2.0)]]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 10)
+
+
+def test_batched_bagging_byte_equal():
+    X, y = _data()
+    base = dict(BASE, bagging_fraction=0.7, feature_fraction=0.8)
+    grids = [dict(base, learning_rate=0.1, bagging_freq=1, bagging_seed=3),
+             dict(base, learning_rate=0.07, bagging_freq=2, bagging_seed=9,
+                  feature_fraction_seed=11),
+             dict(base, learning_rate=0.2, bagging_freq=1, bagging_seed=42,
+                  lambda_l1=0.5),
+             dict(base, learning_rate=0.15, bagging_freq=3,
+                  bagging_seed=77)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=8)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 8)
+
+
+def test_batched_multiclass_byte_equal():
+    X, _ = _data()
+    y = (np.random.RandomState(3).rand(X.shape[0]) * 3).astype(int)
+    base = dict(BASE, objective="multiclass", num_class=3, num_leaves=6)
+    grids = [dict(base, learning_rate=lr)
+             for lr in (0.1, 0.25, 0.05, 0.18)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 6)
+
+
+def test_batched_deep_run_trims_like_sequential():
+    # cross the 16-round deferred-trim boundary
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.3, 0.05)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 20)
+
+
+# ----------------------------------------------------------------------
+# compile discipline: one program, zero retraces afterwards
+# ----------------------------------------------------------------------
+
+def test_models_after_first_cost_zero_traces():
+    X, y = _data(seed=11)
+    ds = lgb.Dataset(X, label=y)
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.1, 0.05, 0.2)]
+    train_many(grids, ds, num_boost_round=4)
+    # a SECOND fleet at the same shapes — different grid values — must
+    # reuse the registered sweep_round program: zero new traces, which
+    # also proves models #2..M of any fleet cost zero traces (they ride
+    # the same single program)
+    before = compile_cache.trace_count()
+    grids2 = [dict(BASE, learning_rate=lr, lambda_l2=l2)
+              for lr, l2 in ((0.3, 1.0), (0.15, 0.2), (0.08, 3.0))]
+    train_many(grids2, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert compile_cache.trace_count() - before == 0
+    assert any(t.startswith("sweep_round:")
+               for t in compile_cache.registered_program_tags())
+
+
+def test_shared_grid_signature_ignores_swept_fields():
+    from lightgbm_tpu.config import Config
+    a = Config.from_params(dict(BASE, learning_rate=0.1, lambda_l2=1.0))
+    b = Config.from_params(dict(BASE, learning_rate=0.3, lambda_l2=0.0,
+                                tpu_sweep_mode="batched"))
+    c = Config.from_params(dict(BASE, learning_rate=0.1, num_leaves=15))
+    assert shared_grid_signature(a) == shared_grid_signature(b)
+    assert shared_grid_signature(a) != shared_grid_signature(c)
+    assert "learning_rate" in SWEEP_VARYING
+
+
+# ----------------------------------------------------------------------
+# gate + mode selection
+# ----------------------------------------------------------------------
+
+def test_gate_rejects_non_grid_divergence_and_batched_raises():
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=0.1),
+             dict(BASE, learning_rate=0.1, num_leaves=15)]
+    with pytest.raises(lgb.LightGBMError, match="differs outside"):
+        train_many([dict(p, tpu_sweep_mode="batched") for p in grids],
+                   lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_auto_mode_falls_back_and_matches_sequential():
+    # heterogeneous num_leaves: auto must route to interleaved and the
+    # models must still match their sequential twins exactly
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=0.1, num_leaves=7),
+             dict(BASE, learning_rate=0.2, num_leaves=15)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 5)
+
+
+def test_forced_interleaved_matches_batched():
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.1, 0.2)]
+    batched = train_many(grids, lgb.Dataset(X, label=y),
+                         num_boost_round=5)
+    inter = train_many([dict(p, tpu_sweep_mode="interleaved")
+                        for p in grids],
+                       lgb.Dataset(X, label=y), num_boost_round=5)
+    assert _texts(batched) == _texts(inter)
+
+
+# ----------------------------------------------------------------------
+# warm start + fleet checkpoint/resume
+# ----------------------------------------------------------------------
+
+def test_warm_start_matches_engine_init_model():
+    X, y = _data()
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.1, 0.2)]
+    seeds = [lgb.train(dict(p), lgb.Dataset(X, label=y),
+                       num_boost_round=3) for p in grids]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=4,
+                       init_models=seeds)
+    for p, s, got in zip(grids, seeds, fleet):
+        ref = lgb.train(dict(p), lgb.Dataset(X, label=y),
+                        num_boost_round=4, init_model=s)
+        assert got.model_to_string() == ref.model_to_string()
+
+
+def test_fleet_checkpoint_resume_bitwise(tmp_path):
+    X, y = _data()
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.1, 0.05, 0.2)]
+    full = _seq_texts(grids, X, y, 9)
+    ck = [dict(p, tpu_sweep_checkpoint_dir=str(tmp_path),
+               tpu_sweep_checkpoint_freq=4) for p in grids]
+    # first run stops mid-sweep; every model of every round must be
+    # restored bitwise by the second run
+    train_many([dict(p) for p in ck], lgb.Dataset(X, label=y),
+               num_boost_round=4)
+    man = json.loads((tmp_path / "MANIFEST.json").read_text())
+    assert man["latest"] == "ckpt_000004" and man["models"] == 3
+    state = json.loads(
+        (tmp_path / "ckpt_000004" / "state.json").read_text())
+    assert state["mode"] == "batched" and state["iters"] == [4, 4, 4]
+    resumed = train_many([dict(p) for p in ck], lgb.Dataset(X, label=y),
+                         num_boost_round=9)
+    assert _texts(resumed) == full
+
+
+def test_fleet_resume_rejects_config_drift(tmp_path):
+    X, y = _data(n=200, f=6)
+    ck = dict(BASE, learning_rate=0.1,
+              tpu_sweep_checkpoint_dir=str(tmp_path),
+              tpu_sweep_checkpoint_freq=2)
+    train_many([dict(ck)], lgb.Dataset(X, label=y), num_boost_round=2)
+    drifted = dict(ck, num_leaves=15)
+    with pytest.raises(lgb.LightGBMError, match="signature"):
+        train_many([drifted], lgb.Dataset(X, label=y), num_boost_round=4)
+
+
+# ----------------------------------------------------------------------
+# observability
+# ----------------------------------------------------------------------
+
+def test_sweep_ledger_records(tmp_path):
+    from lightgbm_tpu.obs.ledger import read_ledger
+    X, y = _data(n=200, f=6)
+    tdir = str(tmp_path / "trace")
+    grids = [dict(BASE, learning_rate=lr, tpu_trace=True,
+                  tpu_trace_dir=tdir) for lr in (0.1, 0.2)]
+    train_many(grids, lgb.Dataset(X, label=y), num_boost_round=3)
+    rows = []
+    for name in os.listdir(tdir):
+        if name.startswith("ledger-"):
+            rows.extend(read_ledger(os.path.join(tdir, name)))
+    inits = [r for r in rows if r.get("note") == "sweep_init"]
+    assert len(inits) == 1 and inits[0]["models"] == 2
+    rounds = [r for r in rows if r.get("kind") == "round"
+              and r.get("path") == "sweep"]
+    # one record per (model, round), partitioned by the model key
+    assert {r["model"] for r in rounds} == {0, 1}
+    assert sorted(r["round"] for r in rounds if r["model"] == 0) \
+        == [0, 1, 2]
+    # trace cost is attributed once (model 0), zero for the rest
+    assert all(r["traces"] == 0 for r in rounds if r["model"] != 0)
+
+
+# ----------------------------------------------------------------------
+# serving refresh loop
+# ----------------------------------------------------------------------
+
+def test_refresh_many_serving_layout(tmp_path):
+    from lightgbm_tpu.serving.registry import load_checkpoint_model_text
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=lr) for lr in (0.1, 0.2)]
+    dirs = [str(tmp_path / f"model_{m}") for m in range(2)]
+    first = refresh_many([dict(p) for p in grids],
+                         lgb.Dataset(X, label=y), dirs, num_boost_round=3)
+    for d, bst in zip(dirs, first):
+        got = load_checkpoint_model_text(d)
+        assert got is not None and got[1] == "ckpt_000001"
+        assert got[0] == bst.model_to_string()
+    # the next cycle warm-starts from the served version and publishes
+    # the next version atomically
+    second = refresh_many([dict(p) for p in grids],
+                          lgb.Dataset(X, label=y), dirs, num_boost_round=3)
+    for d, a, b in zip(dirs, first, second):
+        got = load_checkpoint_model_text(d)
+        assert got[1] == "ckpt_000002"
+        assert len(b.trees) > len(a.trees)
+        # the warm start keeps the served trees verbatim at the front
+        for ta, tb in zip(a.trees, b.trees):
+            assert np.array_equal(ta.leaf_value[:ta.num_leaves],
+                                  tb.leaf_value[:tb.num_leaves])
+
+
+def test_write_serving_checkpoint_versions(tmp_path):
+    d = str(tmp_path / "slot")
+    assert write_serving_checkpoint(d, "model-a") == "ckpt_000001"
+    assert write_serving_checkpoint(d, "model-b") == "ckpt_000002"
+    man = json.loads(
+        open(os.path.join(d, "MANIFEST.json")).read())
+    assert man["latest"] == "ckpt_000002"
+    with open(os.path.join(d, "ckpt_000002", "model.txt")) as fh:
+        assert fh.read() == "model-b"

@@ -74,6 +74,10 @@ DIRECTION: Dict[str, int] = {
     "warm_speedup": +1,
     "coalesced_vs_direct": +1,
     "mslr_rank_fused_speedup": +1,
+    "sweep_models_per_s_m8": +1,     # batched fleet throughput
+    "sweep_models_per_s_m32": +1,
+    "sweep_speedup_m8": +1,          # batched vs M sequential runs
+    "sweep_speedup_m32": +1,
     "auc": +1,
     "auc_ours_1m_100it": +1,
     "ndcg10": +1,
@@ -95,6 +99,8 @@ METRIC_STAGE = {
     "valid_overhead_pct": "valid_overhead",
     "warm_speedup": "warm_rerun",
     "auc_ours_1m_100it": "ref_parity",
+    "sweep_models_per_s_m8": "sweep", "sweep_speedup_m8": "sweep",
+    "sweep_models_per_s_m32": "sweep", "sweep_speedup_m32": "sweep",
 }
 # keys never judged nor listed as informational scalars
 _SKIP_KEYS = frozenset({"metric", "unit", "stage_reached", "stages_done",
