@@ -900,6 +900,84 @@ def run_warm_rerun(out):
         log(f"# warm rerun FAILED: {type(e).__name__}: {e}")
 
 
+def run_coldstart(smoke):
+    """Fresh-subprocess cold-start-to-first-score wall, with and without
+    an AOT serving artifact (serve/aot.py), plus per-model HBM residency
+    f32 vs the int8 compact plan. Each `task=serve` twin is a genuinely
+    cold process (no shared jit caches); the AOT twin must reach its
+    first scored request with zero engine compiles."""
+    import subprocess
+    import tempfile
+    work = tempfile.mkdtemp(prefix="bench_coldstart_")
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        rng = np.random.default_rng(11)
+        n, f = (1_500, 10) if smoke else (5_000, 20)
+        X = rng.standard_normal((n, f))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbosity": -1}, ds,
+                        num_boost_round=20 if smoke else 100)
+        model = os.path.join(work, "model.txt")
+        bst.save_model(model)
+        data = os.path.join(work, "rows.tsv")
+        with open(data, "w") as fh:
+            for i in range(min(n, 500)):
+                fh.write("0\t" + "\t".join(f"{v:g}" for v in X[i])
+                         + "\n")
+        aot_dir = os.path.join(work, "aot")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "serve_export.py"),
+             "--model", model, "--out", aot_dir,
+             "--buckets", "256,512"],
+            check=True, capture_output=True, text=True, env=env,
+            timeout=600)
+
+        def serve_wall(extra):
+            args = [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+                    f"input_model=m={model}", f"data={data}",
+                    f"output_result={os.path.join(work, 'out.tsv')}",
+                    "tpu_serve_max_batch_rows=512", "verbosity=1"] + extra
+            t0 = time.perf_counter()
+            res = subprocess.run(args, check=True, capture_output=True,
+                                 text=True, env=env, timeout=600)
+            wall = time.perf_counter() - t0
+            line = [ln for ln in res.stdout.splitlines()
+                    if ln.startswith("Serving stats: ")][-1]
+            stats = json.loads(line[len("Serving stats: "):])
+            return wall, stats["registry"]["models"]["m"]
+
+        cold_s, cold_m = serve_wall([])
+        aot_s, aot_m = serve_wall([f"tpu_serve_aot_dir={aot_dir}"])
+        res = {
+            "coldstart_cold_s": round(cold_s, 2),
+            "coldstart_aot_s": round(aot_s, 2),
+            "coldstart_speedup": round(cold_s / max(aot_s, 1e-9), 2),
+            "coldstart_cold_compiles": int(cold_m["compile_count"]),
+            "coldstart_aot_compiles": int(aot_m["compile_count"]),
+        }
+        # per-model residency: the same forest under f32 vs the int8
+        # compact plan (in-process — device_bytes is shape metadata)
+        from lightgbm_tpu.serve import ForestEngine
+        e32 = ForestEngine(bst.trees, num_class=1, mode="raw")
+        ec = ForestEngine(bst.trees, num_class=1, mode="raw",
+                          compact="int8")
+        mb = float(1 << 20)
+        res["serve_hbm_per_model_mb_f32"] = round(
+            e32.device_bytes() / mb, 4)
+        res["serve_hbm_per_model_mb_compact"] = round(
+            ec.device_bytes() / mb, 4)
+        res["serve_model_density_x"] = round(
+            e32.device_bytes() / max(ec.device_bytes(), 1), 2)
+        return res
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main() -> None:
     if os.environ.get("BENCH_MULTICHIP_CHILD") == "1":
         multichip_child()
@@ -1098,6 +1176,26 @@ def main() -> None:
         except Exception as e:   # the summary line must still print
             log(f"# serve_traffic stage FAILED: {type(e).__name__}: {e}")
         _stage_done("serve_traffic", out)
+
+    # ---- stage 4.6: serving cold start (serve/aot.py artifacts): fresh
+    # subprocess to first score with vs without the AOT artifact, plus
+    # per-model HBM residency f32 vs compact --------------------------
+    if stage_gate(out, "coldstart", "BENCH_SKIP_COLDSTART",
+                  est_s=45 if smoke else 120):
+        _stage("coldstart")
+        try:
+            cs = run_coldstart(smoke)
+            out.update(cs)
+            log(f"# coldstart: cold={cs['coldstart_cold_s']}s "
+                f"aot={cs['coldstart_aot_s']}s "
+                f"({cs['coldstart_speedup']}x, aot_compiles="
+                f"{cs['coldstart_aot_compiles']}); per-model MB "
+                f"f32={cs['serve_hbm_per_model_mb_f32']} vs "
+                f"compact={cs['serve_hbm_per_model_mb_compact']} "
+                f"({cs['serve_model_density_x']}x density)")
+        except Exception as e:   # the summary line must still print
+            log(f"# coldstart stage FAILED: {type(e).__name__}: {e}")
+        _stage_done("coldstart", out)
 
     # ---- stage 5: valid-set overhead (diagnostic) ----------------------
     if stage_gate(out, "valid_overhead", "BENCH_SKIP_VALID",

@@ -406,6 +406,12 @@ assert doc["memory"]["claimed_bytes"] > 0
 assert "hbm_unattributed_bytes" in doc["memory"]
 hist = doc["metrics"]["histograms"]['serve_request_latency_ms{model="m"}']
 assert hist["count"] > 0 and hist["p99_ms"] is not None
+# per-model AOT/compact detail rides the same JSON view (no artifact
+# and no compact plan in this smoke: zeros, but the fields must exist)
+srv = doc["serving"]["models"]["m"]
+assert srv["compact"]["plan"] == "off", srv
+assert srv["compact"]["f32_bytes"] >= srv["compact"]["bytes"] > 0, srv
+assert srv["aot"]["buckets"] == 0, srv
 
 # -- request tracing: /debug/requests + tail sampling + exemplars ------
 n_req = int(series("serve_requests_total"))
@@ -488,6 +494,106 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
     echo "metrics artifacts kept under $MET_DIR for artifact upload"
 else
     rm -rf "$(dirname "$MET_DIR")"
+fi
+
+echo "== AOT serving artifact smoke (zero-trace cold start + compact parity) =="
+AOT_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_aot"
+mkdir -p "$AOT_DIR"
+LGBT_AOT_DIR="$AOT_DIR" python - <<'EOF'
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+adir = os.environ["LGBT_AOT_DIR"]
+rng = np.random.RandomState(7)
+X = rng.randn(500, 8).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                lgb.Dataset(X, label=y), num_boost_round=10)
+bst.save_model(os.path.join(adir, "model.txt"))
+np.savetxt(os.path.join(adir, "rows.tsv"),
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+# export the artifact: buckets must cover the warm-up bucket (256) and
+# the request bucket (500 rows at max_batch_rows=512 -> 512)
+python tools/serve_export.py --model "$AOT_DIR/model.txt" \
+    --out "$AOT_DIR/aot" --buckets 256,512 > "$AOT_DIR/export.json"
+# cold-compiled twin: traces its programs in-process as usual
+python -m lightgbm_tpu task=serve "input_model=m=$AOT_DIR/model.txt" \
+    "data=$AOT_DIR/rows.tsv" "output_result=$AOT_DIR/pred_cold.tsv" \
+    tpu_serve_max_batch_rows=512 \
+    verbosity=1 > "$AOT_DIR/cold.log" 2>&1
+# fresh process against the artifact: first score with ZERO new traces
+python -m lightgbm_tpu task=serve "input_model=m=$AOT_DIR/model.txt" \
+    "data=$AOT_DIR/rows.tsv" "output_result=$AOT_DIR/pred_aot.tsv" \
+    "tpu_serve_aot_dir=$AOT_DIR/aot" tpu_serve_max_batch_rows=512 \
+    verbosity=1 > "$AOT_DIR/aot.log" 2>&1
+cmp "$AOT_DIR/pred_cold.tsv" "$AOT_DIR/pred_aot.tsv"
+LGBT_AOT_DIR="$AOT_DIR" python - <<'EOF'
+import json
+import os
+
+adir = os.environ["LGBT_AOT_DIR"]
+
+
+def stats(log):
+    tag = "Serving stats: "
+    lines = [ln for ln in open(os.path.join(adir, log)) if ln.startswith(tag)]
+    assert lines, f"{log} has no serving stats line"
+    return json.loads(lines[-1][len(tag):])["registry"]["models"]["m"]
+
+
+cold = stats("cold.log")
+aot = stats("aot.log")
+assert cold["compile_count"] > 0, cold
+assert aot["compile_count"] == 0, \
+    f"AOT serve traced {aot['compile_count']} programs before first score"
+assert aot["aot_buckets"] == 2 and aot["aot_hits"] > 0, aot
+# the artifact hit also lands on the structured event channel
+aot_log = open(os.path.join(adir, "aot.log")).read()
+assert "serve_aot" in aot_log and '"status": "hit"' in aot_log, \
+    aot_log[-2000:]
+print(f"AOT smoke: ok (cold compiles={cold['compile_count']}, "
+      f"aot compiles=0, buckets={aot['aot_buckets']}, "
+      f"byte-identical scores)")
+EOF
+# compact-parity leg: int8 either passes the parity gate (serve_compact)
+# or emits exactly one serve_compact_fallback and serves f32-identical —
+# never silent drift
+python -m lightgbm_tpu task=serve "input_model=m=$AOT_DIR/model.txt" \
+    "data=$AOT_DIR/rows.tsv" "output_result=$AOT_DIR/pred_int8.tsv" \
+    tpu_serve_compact=int8 tpu_serve_max_batch_rows=512 \
+    verbosity=1 > "$AOT_DIR/int8.log" 2>&1
+LGBT_AOT_DIR="$AOT_DIR" python - <<'EOF'
+import json
+import os
+
+adir = os.environ["LGBT_AOT_DIR"]
+log = open(os.path.join(adir, "int8.log")).read()
+ok = log.count('"event": "serve_compact"')
+fb = log.count('"event": "serve_compact_fallback"')
+assert (ok == 1) != (fb == 1), \
+    f"want exactly one of serve_compact/serve_compact_fallback, got {ok}/{fb}"
+plan = json.loads(
+    [ln for ln in open(os.path.join(adir, "int8.log"))
+     if ln.startswith("Serving stats: ")][-1][len("Serving stats: "):]
+)["registry"]["models"]["m"]["compact"]
+if fb:
+    assert plan == "off", plan
+    cold = open(os.path.join(adir, "pred_cold.tsv"), "rb").read()
+    got = open(os.path.join(adir, "pred_int8.tsv"), "rb").read()
+    assert got == cold, "fallback engine must score f32-identical"
+else:
+    assert plan == "int8", plan
+print(f"compact parity leg: ok "
+      f"({'gate passed (int8 resident)' if ok else 'clean fallback to f32'})")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "AOT artifacts kept under $AOT_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$AOT_DIR")"
 fi
 
 echo "== bench_compare sentinel (history trajectory + regression gate) =="

@@ -700,7 +700,7 @@ class Booster:
         # amortize a compile.
         pd = str(opts.get("tpu_predict_device", "auto")).strip().lower()
         import jax
-        use_engine = bool(trees) and not pred_contrib and not es_on and (
+        use_engine = bool(trees) and not pred_contrib and (
             pd in ("on", "device", "true", "1")
             or (pd == "auto"
                 and (jax.default_backend() != "cpu"
@@ -722,10 +722,20 @@ class Booster:
                       fallback_calls=self._predict_fallback_calls)
         if use_engine:
             eng = self._serve_engine(trees, s_iter, u_spec)
-            if bool(opts.get("predict_sharded", False)) and not pred_leaf:
+            # pred_early_stop rides the engine as a chunked early-exit
+            # (ForestEngine scores freq*k-tree segments and skips the
+            # rest once the whole chunk clears the margin) — same
+            # reference semantics as the native walk, chunk-granular
+            es = None
+            if es_on:
+                es = (int(opts.get("pred_early_stop_freq", 10)) * k,
+                      float(opts.get("pred_early_stop_margin", 10.0)))
+            if bool(opts.get("predict_sharded", False)) and not pred_leaf \
+                    and es is None:
                 raw = eng.predict_sharded(X)
             else:
-                raw, leaves = eng.predict(X, pred_leaf=pred_leaf)
+                raw, leaves = eng.predict(X, pred_leaf=pred_leaf,
+                                          early_stop=es)
                 if pred_leaf:
                     return leaves
         else:

@@ -301,6 +301,11 @@ class Application:
                       f"{target!r}. Results saved to {out}")
             print("Serving stats: "
                   + json.dumps(svc.stats(), sort_keys=True, default=str))
+            ac = svc.registry.aot_compact_stats()
+            if any(m["aot"]["buckets"] or m["compact"]["plan"] != "off"
+                   for m in ac.values()):
+                print("Serving aot/compact: "
+                      + json.dumps(ac, sort_keys=True, default=str))
             if svc.exporter is not None:
                 print(f"Metrics: {svc.exporter.url}/metrics "
                       f"(Prometheus) and /metrics.json", flush=True)

@@ -631,6 +631,32 @@ class Config:
     # serve_slo_burn_rate gauge — the admission/load-shedding signal.
     # 0 disables SLO classification (nothing breaches)
     tpu_serve_slo_ms: float = 0.0
+    # AOT serving-artifact directory (serve/aot.py): jax.export
+    # serialized forest-traversal programs keyed by an artifact
+    # signature (jax version, backend, dtype plan, forest shape). At
+    # model load the registry attaches matching buckets so a fresh
+    # process reaches first score with zero new jax traces; a signature
+    # mismatch emits a serve_aot event and falls back to normal jit.
+    # Write artifacts with tools/serve_export.py. Empty disables.
+    # Runtime-only: excluded from model text and checkpoint signatures
+    tpu_serve_aot_dir: str = ""
+    # compact residency plan for served forests: "off" (f32 engine,
+    # bit-exact f64 routing), "f16" (thresholds + leaf values as
+    # float16), or "int8" (per-feature affine int8 thresholds, the
+    # ops/histogram.quantize_gh per-column scale discipline, f16
+    # leaves). Compact engines route on f32 compares, so every load is
+    # parity-gated against the f64 oracle: failing the gate emits
+    # serve_compact_fallback and keeps the f32 engine — never silent
+    # drift. Roughly 2.2x more models fit the same
+    # tpu_serve_hbm_budget_mb. Runtime-only: excluded from model text
+    # and checkpoint signatures
+    tpu_serve_compact: str = "off"
+    # parity-gate tolerance for compact plans: max |compact - oracle|
+    # margin error allowed, relative to max(1, max |oracle margin|)
+    # over the probe batch. Exceeding it rejects the compact plan for
+    # that model (serve_compact_fallback). Runtime-only: excluded from
+    # model text and checkpoint signatures
+    tpu_serve_compact_tol: float = 0.05
     # runtime lock-discipline assertions (utils/locks.py): install a
     # checking __setattr__ on the serving/metrics classes whose shared
     # state is declared `# guarded-by:` — a guarded attribute rebound
@@ -762,6 +788,11 @@ class Config:
         if not self.label_gain:
             # default label gain 2^i - 1 (reference config.h:715-722)
             self.label_gain = [float((1 << i) - 1) for i in range(31)]
+        self.tpu_serve_compact = self.tpu_serve_compact.strip().lower()
+        if self.tpu_serve_compact not in ("off", "f16", "int8"):
+            raise ValueError(
+                f"tpu_serve_compact must be off/f16/int8, got "
+                f"{self.tpu_serve_compact!r}")
 
     def _check_conflicts(self) -> None:
         """Parameter-conflict resolution (reference `CheckParamConflict`

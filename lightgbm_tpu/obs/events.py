@@ -46,6 +46,12 @@ EVENTS: Dict[str, str] = {
     # prediction / serving
     "predict_route": "Booster.predict routing decision (device engine "
                      "vs native host walk) and why",
+    "serve_aot": "AOT artifact export/load outcome (hit / miss / "
+                 "signature_mismatch / export / prefill / bad blob)",
+    "serve_compact": "compact dtype plan passed the parity gate at model "
+                     "load: plan, bytes, bytes saved vs f32",
+    "serve_compact_fallback": "compact plan FAILED the parity gate; the "
+                              "load fell back to the f32 engine",
     "serve_compile": "ForestEngine compiled a new shape-bucket program",
     "serve_evict": "registry evicted an LRU entry over the HBM budget",
     "serve_load": "registry loaded (or replaced) a named model",

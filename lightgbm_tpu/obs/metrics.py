@@ -505,7 +505,23 @@ def serving_instruments() -> Any:
         "serve_model_swaps_total", "registry hot swaps")
     ns.evictions = r.counter(
         "serve_model_evictions_total", "registry LRU evictions")
+    ns.early_stop = r.counter(
+        "serve_early_stop_total",
+        "prediction chunks that exited before scoring every tree "
+        "(pred_early_stop on the batched engine path)")
     return ns
+
+
+def note_early_stop() -> None:
+    """One chunk exited the forest early (`ForestEngine` pred_early_stop).
+    No-op when the metrics plane is off — the engine calls this
+    unconditionally because exits are bounded by chunk count."""
+    if not _enabled:
+        return
+    _REGISTRY.counter(
+        "serve_early_stop_total",
+        "prediction chunks that exited before scoring every tree "
+        "(pred_early_stop on the batched engine path)").inc()
 
 
 def note_retry_event(event: str) -> None:

@@ -56,6 +56,7 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_warm_rows", "tpu_metrics", "tpu_serve_metrics_port",
     "tpu_serve_hold_s", "tpu_serve_trace", "tpu_serve_trace_dir",
     "tpu_serve_trace_sample", "tpu_serve_trace_ring", "tpu_serve_slo_ms",
+    "tpu_serve_aot_dir", "tpu_serve_compact", "tpu_serve_compact_tol",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
     # sweep-trainer infrastructure (sweep/): a fleet checkpoint may be

@@ -1,3 +1,6 @@
 """Batch inference engine: device-resident stacked forests, depth-
-synchronized traversal, shape-bucketed jit cache (ROADMAP serving path)."""
-from .engine import ForestEngine, stack_forest  # noqa: F401
+synchronized traversal, shape-bucketed jit cache, compact dtype plans,
+and AOT artifact export/load (ROADMAP serving path)."""
+from .engine import (COMPACT_PLANS, ForestEngine, compact_stack,  # noqa: F401
+                     stack_forest)
+from . import aot  # noqa: F401

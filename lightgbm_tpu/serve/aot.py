@@ -1,0 +1,199 @@
+"""Ahead-of-time serving artifacts: export the bucketed forest-traversal
+programs of a `ForestEngine` to disk and re-attach them in a fresh process
+with ZERO new jax traces before the first scored request.
+
+The artifact directory holds one `jax.export` serialized executable per
+shape bucket plus a MANIFEST.json carrying the **artifact signature** —
+everything that must match for a deserialized program to be valid for a
+given engine:
+
+    (jax version, backend, engine mode, compact dtype plan, num_class,
+     num_trees, max_depth, has_cat, num_features, and the exact
+     (key, shape, dtype) plan of the device-resident stack)
+
+Shape buckets are deliberately NOT in the signature: a manifest maps each
+exported bucket to its blob, and an engine simply falls back to its own
+`jax.jit` for buckets the artifact doesn't cover. A signature mismatch is
+a clean rebuild (structured ``serve_aot`` event, engine keeps its jit
+path), never a crash — artifacts are a warm-start cache, not a format the
+server depends on.
+
+Where `jax.export` is unavailable (older jax, exotic backends) the
+exporter degrades to prefilling the persistent compilation cache
+(`compile_cache.init_persistent_cache`): first-score then pays a trace
+but no XLA compile. `tools/serve_export.py` is the CLI wrapper;
+`serving/registry.py` calls `load_artifact` at model-load time when
+`tpu_serve_aot_dir` is set.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils import log
+
+ARTIFACT_MANIFEST = "MANIFEST.json"
+SCHEMA_VERSION = 1
+
+__all__ = ["ARTIFACT_MANIFEST", "SCHEMA_VERSION", "artifact_signature",
+           "export_artifact", "load_artifact"]
+
+
+def _export_module():
+    """`jax.export` if this jax has it, else None (degrade to
+    persistent-cache prefill)."""
+    try:
+        from jax import export as jax_export
+        if hasattr(jax_export, "export") and hasattr(jax_export,
+                                                     "deserialize"):
+            return jax_export
+    except ImportError:
+        pass
+    return None
+
+
+def artifact_signature(engine, num_features: int) -> Dict[str, object]:
+    """Everything a serialized traversal program is specialized on. Two
+    engines with equal signatures accept each other's exported buckets;
+    any difference (model shape, dtype plan, jax version...) must force a
+    clean rebuild."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "mode": engine.mode,
+        "compact": engine.compact,
+        "num_class": int(engine.num_class),
+        "num_trees": int(engine.num_trees),
+        "max_depth": int(engine.max_depth),
+        "has_cat": bool(engine.has_cat),
+        "num_features": int(num_features),
+        # lists, not tuples: the signature must compare equal after a
+        # JSON round-trip through the manifest
+        "stack": sorted(
+            [k, [int(s) for s in v.shape], str(v.dtype)]
+            for k, v in engine._stk.items()),
+    }
+
+
+def _specs(engine, num_features: int, bucket: int):
+    """(stack specs, plane specs) for one bucket: ShapeDtypeStructs
+    mirroring exactly what `predict` passes to `_run`. Plane dtypes come
+    from a probe encode of a zero row so the spec tracks the engine's
+    encoding (key planes vs compact f32 plane vs extra cat plane)."""
+    stk_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in engine._stk.items()}
+    probe = engine._encode(np.zeros((1, num_features)))
+    plane_specs = tuple(
+        jax.ShapeDtypeStruct((num_features, bucket), p.dtype)
+        for p in probe)
+    return stk_specs, plane_specs
+
+
+def export_artifact(engine, out_dir: str, buckets: Iterable[int],
+                    num_features: int) -> Dict[str, object]:
+    """Write an AOT artifact directory for `engine` covering `buckets`.
+
+    Returns the manifest dict. With `jax.export` available, each bucket's
+    traversal program is serialized to ``bucket_<b>.bin``; otherwise the
+    manifest records ``"prefill"`` and first-load warms through the
+    persistent compile cache instead.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    buckets = sorted({int(b) for b in buckets if int(b) > 0})
+    exp_mod = _export_module()
+    manifest: Dict[str, object] = {
+        "schema": SCHEMA_VERSION,
+        "signature": artifact_signature(engine, num_features),
+        "kind": "export" if exp_mod is not None else "prefill",
+        "buckets": {},
+    }
+    for b in buckets:
+        stk_specs, plane_specs = _specs(engine, num_features, b)
+        if exp_mod is not None:
+            exp = exp_mod.export(jax.jit(engine._run))(stk_specs,
+                                                       plane_specs)
+            blob = exp.serialize()
+            name = f"bucket_{b}.bin"
+            with open(os.path.join(out_dir, name), "wb") as fh:
+                fh.write(blob)
+            manifest["buckets"][str(b)] = name
+        else:
+            # no export support: at least populate the persistent XLA
+            # cache (if one is configured) so a fresh process pays a
+            # trace but not a compile
+            engine._jit_run.lower(stk_specs, plane_specs).compile()
+            manifest["buckets"][str(b)] = "prefill"
+    with open(os.path.join(out_dir, ARTIFACT_MANIFEST), "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+    log.event("serve_aot", status="export", dir=out_dir,
+              buckets=len(buckets), artifact=manifest["kind"])
+    return manifest
+
+
+def _signature_diff(want: Dict[str, object],
+                    have: Dict[str, object]) -> list:
+    keys = sorted(set(want) | set(have))
+    return [k for k in keys if want.get(k) != have.get(k)]
+
+
+def load_artifact(engine, aot_dir: str, num_features: int,
+                  model: str = "") -> int:
+    """Attach an artifact directory's exported programs to `engine`.
+
+    Returns the number of buckets attached (0 on any miss). Every outcome
+    emits one structured ``serve_aot`` event; a signature mismatch or a
+    corrupt blob is a clean fall-through to the engine's own jit path.
+    """
+    path = os.path.join(aot_dir, ARTIFACT_MANIFEST)
+    if not os.path.isfile(path):
+        log.event("serve_aot", status="miss", dir=aot_dir, model=model)
+        return 0
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as exc:
+        log.event("serve_aot", status="bad_manifest", dir=aot_dir,
+                  model=model, error=str(exc))
+        return 0
+    want = artifact_signature(engine, num_features)
+    have = manifest.get("signature", {})
+    diff = _signature_diff(want, have)
+    if diff:
+        log.event("serve_aot", status="signature_mismatch", dir=aot_dir,
+                  model=model, mismatch=diff)
+        return 0
+    if manifest.get("kind") != "export":
+        # prefill artifacts carry no blobs; the persistent cache (if
+        # configured) already holds the compiled programs
+        log.event("serve_aot", status="prefill", dir=aot_dir, model=model,
+                  buckets=len(manifest.get("buckets", {})))
+        return 0
+    exp_mod = _export_module()
+    if exp_mod is None:
+        log.event("serve_aot", status="no_export_support", dir=aot_dir,
+                  model=model)
+        return 0
+    calls: Dict[int, object] = {}
+    for b_str, name in manifest.get("buckets", {}).items():
+        try:
+            with open(os.path.join(aot_dir, name), "rb") as fh:
+                blob = fh.read()
+            exp = exp_mod.deserialize(blob)
+            # jit the deserialized call for dispatch caching; this traces
+            # only exp.call's thin wrapper, never the _run body, so the
+            # note_trace probe stays untouched
+            calls[int(b_str)] = jax.jit(exp.call)
+        except Exception as exc:   # corrupt blob -> skip, engine jit covers
+            log.event("serve_aot", status="bad_blob", dir=aot_dir,
+                      model=model, bucket=b_str, error=str(exc))
+    if not calls:
+        return 0
+    engine.attach_aot(calls, source=aot_dir)
+    log.event("serve_aot", status="hit", dir=aot_dir, model=model,
+              buckets=len(calls))
+    return len(calls)

@@ -21,6 +21,22 @@ doubles are encoded host-side into monotonic uint64 total-order keys split
 into two uint32 planes, so `x <= t` becomes a two-limb unsigned compare.
 Leaf routing is therefore bit-exact vs the host f64 walk
 (`predict_raw_values`); only the final leaf-value sum runs in f32.
+
+Two serving-density extensions ride on the same traversal:
+
+* **compact dtype plans** (``compact="f16"/"int8"``): thresholds stored
+  as f16 (or per-feature affine int8, the `ops/histogram.quantize_gh`
+  per-column scale discipline applied to split thresholds), leaf values
+  as f16 de-quantized to f32 on output, and the int32 topology arrays
+  (children / split features) narrowed to int16. Routing then compares
+  f32 values against the de-quantized threshold instead of the exact
+  key planes, so compact engines are gated behind a parity check
+  against the f64 oracle (serving/registry.py) — never silently wrong;
+* **AOT artifacts** (serve/aot.py): the bucketed traversal program can
+  be `jax.export`ed ahead of time and re-attached in a fresh process
+  (``attach_aot``), so the first scored request performs zero new jax
+  traces — `compile_cache.note_trace` is the probe (every `_run` body
+  bumps it; a deserialized artifact call never runs the body).
 """
 from __future__ import annotations
 
@@ -31,10 +47,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import compile_cache
 from ..models.tree import Tree
 from ..ops.predict import stack_trees
 
-__all__ = ["ForestEngine", "stack_forest"]
+__all__ = ["ForestEngine", "stack_forest", "compact_stack",
+           "COMPACT_PLANS"]
 
 
 # ---------------------------------------------------------------------------
@@ -100,10 +118,92 @@ def stack_forest(trees: List[Tree], num_class: int = 1,
     return stk
 
 
+COMPACT_PLANS = ("off", "f16", "int8")
+
+
+def _narrow_i16(a: np.ndarray) -> np.ndarray:
+    """int16 when the values fit, else the array unchanged (a >32k-leaf
+    tree or a >32k-word cat bitset keeps exact int32 addressing)."""
+    if a.size and np.int64(a.min()) >= -32768 and np.int64(a.max()) <= 32767:
+        return a.astype(np.int16)
+    return a
+
+
+def compact_stack(host: Dict[str, object], plan: str) -> Dict[str, object]:
+    """Rewrite a raw-mode host stack (`stack_forest` output) under a
+    compact dtype plan.
+
+    ``f16``: thresholds as float16, compared in f32 after upcast.
+    ``int8``: per-feature affine quantization — for feature ``j`` with
+    numerical-split thresholds ``ts``, ``off = mid(ts)`` and ``scale =
+    range(ts) / 254`` (the `quantize_gh` per-column absmax/qmax scale
+    discipline, recentered), so a feature whose thresholds span <= 254
+    distinct affine steps round-trips near-exactly. Both plans store
+    leaf values as f16 (de-quantized to f32 at the output gather) and
+    narrow the int32 topology arrays to int16. Exactness is NOT
+    promised — the serving registry's parity gate is the contract.
+    """
+    if plan not in ("f16", "int8"):
+        raise ValueError(f"unknown compact plan {plan!r}")
+    out = dict(host)
+    for key in ("split_feature", "left_child", "right_child",
+                "cat_start", "cat_len"):
+        out[key] = _narrow_i16(np.asarray(host[key]))
+    thr = np.asarray(host["threshold"], np.float64)
+    if plan == "f16":
+        out["thr_f16"] = thr.astype(np.float16)
+    else:
+        sf = np.asarray(host["split_feature"], np.int64)
+        dt = np.asarray(host["decision_type"], np.int32)
+        nl = np.asarray(host["num_leaves"], np.int32)
+        m = thr.shape[1]
+        # only real numerical internal nodes feed the per-feature
+        # stats: zero-padding rows and categorical nodes would drag
+        # feature 0's range toward 0.0 for nothing (their threshold is
+        # never compared)
+        valid = (np.arange(m, dtype=np.int32)[None, :]
+                 < np.maximum(nl[:, None] - 1, 0)) & ((dt & 1) == 0)
+        nfeat = int(sf.max()) + 1 if sf.size else 1
+        t_lo = np.full(nfeat, np.inf)
+        t_hi = np.full(nfeat, -np.inf)
+        np.minimum.at(t_lo, sf[valid], thr[valid])
+        np.maximum.at(t_hi, sf[valid], thr[valid])
+        unused = ~np.isfinite(t_lo)
+        t_lo[unused] = 0.0
+        t_hi[unused] = 0.0
+        off = (t_lo + t_hi) / 2.0
+        scale = np.maximum((t_hi - t_lo) / 254.0, 1e-30)
+        q = np.clip(np.rint((thr - off[sf]) / scale[sf]), -127, 127)
+        out["thr_q"] = q.astype(np.int8)
+        out["thr_scale"] = scale.astype(np.float32)
+        out["thr_off"] = off.astype(np.float32)
+    out["leaf_value_f16"] = np.asarray(host["leaf_value"],
+                                       np.float64).astype(np.float16)
+    return out
+
+
 _DEVICE_KEYS_RAW = ("split_feature", "decision_type", "left_child",
                     "right_child", "thr_hi", "thr_lo", "cat_start",
                     "cat_len", "cat_words", "leaf_value_f32", "num_leaves",
                     "tree_class")
+_DEVICE_KEYS_COMPACT_COMMON = (
+    "split_feature", "decision_type", "left_child", "right_child",
+    "cat_start", "cat_len", "cat_words", "leaf_value_f16", "num_leaves",
+    "tree_class")
+_DEVICE_KEYS_COMPACT = {
+    "f16": _DEVICE_KEYS_COMPACT_COMMON + ("thr_f16",),
+    "int8": _DEVICE_KEYS_COMPACT_COMMON + ("thr_q", "thr_scale",
+                                           "thr_off"),
+}
+# what the same stacked forest costs under compact=off, per element of
+# each raw-plan array (f32_device_bytes reports the counterfactual so
+# the registry/exporter can say how many bytes a compact plan saved)
+_RAW_PLAN_ITEMSIZE = {
+    "split_feature": 4, "decision_type": 1, "left_child": 4,
+    "right_child": 4, "thr_hi": 4, "thr_lo": 4, "cat_start": 4,
+    "cat_len": 4, "cat_words": 4, "leaf_value_f32": 4, "num_leaves": 4,
+    "tree_class": 4,
+}
 _DEVICE_KEYS_BINNED = ("split_feature", "decision_type", "left_child",
                        "right_child", "threshold_in_bin", "default_bin",
                        "num_bin", "cat_start", "cat_len", "cat_words",
@@ -189,18 +289,27 @@ class ForestEngine:
 
     def __init__(self, trees: List[Tree], num_class: int = 1,
                  mode: str = "raw", chunk_rows: Optional[int] = None,
-                 min_bucket: int = 256) -> None:
+                 min_bucket: int = 256, compact: str = "off") -> None:
         if mode not in ("raw", "binned"):
             raise ValueError(f"unknown engine mode {mode!r}")
+        if compact not in COMPACT_PLANS:
+            raise ValueError(f"unknown compact plan {compact!r}")
+        if compact != "off" and mode == "binned":
+            raise ValueError("compact plans require mode='raw' (binned "
+                             "thresholds are already uint8)")
         if not trees:
             raise ValueError("ForestEngine needs at least one tree")
         self.mode = mode
+        self.compact = compact
         self.num_class = max(int(num_class), 1)
         self.min_bucket = int(min_bucket)
         self._chunk_rows_opt = chunk_rows
         self.compile_count = 0          # bumped at TRACE time only
         self.cache_hits = 0             # chunk dispatches with no new trace
         self.predict_calls = 0
+        self.aot_hits = 0               # chunk dispatches via AOT artifact
+        self.aot_source: Optional[str] = None
+        self.early_stop_exits = 0       # chunks that exited before all trees
         self._jit_run = jax.jit(self._run)
         self._jit_run_routed = jax.jit(self._run_routed)
         self._sharded_cache: dict = {}
@@ -216,9 +325,25 @@ class ForestEngine:
     def _install(self, trees: List[Tree]) -> None:
         host = stack_forest(trees, self.num_class, binned=(
             self.mode == "binned"))
-        keys = (_DEVICE_KEYS_BINNED if self.mode == "binned"
-                else _DEVICE_KEYS_RAW)
+        if self.mode == "binned":
+            keys = _DEVICE_KEYS_BINNED
+            self._f32_bytes = None
+        else:
+            # counterfactual f32-plan footprint: what this forest would
+            # occupy under compact="off" (exporter reports the delta)
+            self._f32_bytes = sum(
+                int(np.asarray(host[k]).size) * _RAW_PLAN_ITEMSIZE[k]
+                for k in _DEVICE_KEYS_RAW)
+            if self.compact != "off":
+                host = compact_stack(host, self.compact)
+                keys = _DEVICE_KEYS_COMPACT[self.compact]
+            else:
+                keys = _DEVICE_KEYS_RAW
         self._stk = {k: jnp.asarray(host[k]) for k in keys}
+        # forest arrays changed shape/content: exported programs and the
+        # early-stop sub-stack slices are stale
+        self._aot_calls: Dict[int, object] = {}
+        self._es_cache: Dict[int, list] = {}
         # engine holds strong refs: tree ids stay unique while cached, so
         # the id-prefix check in update() cannot alias a freed tree
         self.trees = list(trees)
@@ -262,6 +387,23 @@ class ForestEngine:
             total += sum(int(v.nbytes) for v in self._route.values())
         return total
 
+    def f32_device_bytes(self) -> int:
+        """What this forest WOULD occupy under ``compact="off"`` — the
+        baseline the exporter/registry quote compaction savings against.
+        Equals `device_bytes()` when no compact plan is active."""
+        if self._f32_bytes is None:
+            return self.device_bytes()
+        return int(self._f32_bytes)
+
+    def attach_aot(self, calls: Dict[int, object],
+                   source: Optional[str] = None) -> None:
+        """Install ahead-of-time exported traversal programs, one per shape
+        bucket (serve/aot.py `load_artifact`). An attached bucket's chunk
+        dispatch goes through the deserialized executable instead of
+        `jax.jit(self._run)` — no Python re-trace in a fresh process."""
+        self._aot_calls = dict(calls)
+        self.aot_source = source
+
     def update(self, trees: List[Tree]) -> "ForestEngine":
         """Refresh the device forest for a (possibly mutated) tree list.
 
@@ -280,11 +422,15 @@ class ForestEngine:
         return self
 
     def _append(self, new_trees: List[Tree]) -> None:
-        if self._route is not None:
-            # the packed-route table mixes every per-node field; rebuilding
-            # it host-side costs about as much as a full restack
+        if self._route is not None or self.compact != "off":
+            # the packed-route table (and the per-feature affine scales of
+            # a compact plan) mix every per-node field; rebuilding host-side
+            # costs about as much as a full restack
             self._install(self.trees + list(new_trees))
             return
+        # shapes grow: exported programs and early-stop slices are stale
+        self._aot_calls = {}
+        self._es_cache = {}
         host = stack_forest(new_trees, self.num_class,
                             binned=(self.mode == "binned"),
                             class_offset=self.num_trees)
@@ -355,6 +501,41 @@ class ForestEngine:
             go = jnp.where((d & 1) != 0, cat_left, go)
         return go
 
+    def _go_left_raw_compact(self, stk, planes, feat, safe, d, rows):
+        """Compact-plan routing: de-quantized f32 threshold compare on an
+        f32 feature plane (no u64 key planes — compactness trades the
+        bit-exactness guarantee for bytes; the registry parity gate is
+        what stands behind the trade)."""
+        xval, xnan = planes[0], planes[1]
+        if self.compact == "f16":
+            thr = jnp.take_along_axis(stk["thr_f16"], safe,
+                                      axis=1).astype(jnp.float32)
+        else:
+            q = jnp.take_along_axis(stk["thr_q"], safe,
+                                    axis=1).astype(jnp.float32)
+            thr = q * stk["thr_scale"][feat] + stk["thr_off"][feat]
+        x = xval[feat, rows]
+        nn = xnan[feat, rows]
+        default_left = (d & 2) != 0
+        mt = (d >> 2) & 3
+        le = x <= thr
+        near_zero = jnp.abs(x) <= jnp.float32(1e-35)
+        is_default = ((mt == 1) & near_zero) | ((mt == 2) & nn)
+        go = jnp.where(is_default, default_left, le)
+        if self.has_cat:
+            iv = planes[2][feat, rows]
+            cs = jnp.take_along_axis(stk["cat_start"], safe,
+                                     axis=1).astype(jnp.int32)
+            cl = jnp.take_along_axis(stk["cat_len"], safe,
+                                     axis=1).astype(jnp.int32)
+            w = iv >> 5
+            cwords = stk["cat_words"]
+            widx = jnp.clip(cs + w, 0, cwords.shape[0] - 1)
+            bit = ((cwords[widx] >> (iv & 31).astype(jnp.uint32)) & 1) != 0
+            cat_left = bit & (w < cl) & (iv >= 0) & ~(nn & (mt == 2))
+            go = jnp.where((d & 1) != 0, cat_left, go)
+        return go
+
     def _go_left_binned(self, stk, planes, feat, safe, d, rows):
         fval = planes[0][feat, rows].astype(jnp.int32)
         tb = jnp.take_along_axis(stk["threshold_in_bin"], safe, axis=1)
@@ -378,12 +559,18 @@ class ForestEngine:
     def _traverse(self, stk, planes):
         n = planes[0].shape[1]
         rows = jnp.arange(n, dtype=jnp.int32)[None, :]
-        go_left = (self._go_left_binned if self.mode == "binned"
-                   else self._go_left_raw)
+        if self.mode == "binned":
+            go_left = self._go_left_binned
+        elif self.compact != "off":
+            go_left = self._go_left_raw_compact
+        else:
+            go_left = self._go_left_raw
 
         def body(_, node):
             safe = jnp.maximum(node, 0)
-            feat = jnp.take_along_axis(stk["split_feature"], safe, axis=1)
+            # compact plans narrow split_feature to int16; index in int32
+            feat = jnp.take_along_axis(stk["split_feature"], safe,
+                                       axis=1).astype(jnp.int32)
             d = jnp.take_along_axis(stk["decision_type"], safe,
                                     axis=1).astype(jnp.int32)
             go = go_left(stk, planes, feat, safe, d, rows)
@@ -406,8 +593,15 @@ class ForestEngine:
 
     def _run(self, stk, planes):
         self.compile_count += 1
+        compile_cache.note_trace()      # AOT zero-trace probe (ISSUE 16)
         leaf = self._traverse(stk, planes)
-        vals = jnp.take_along_axis(stk["leaf_value_f32"], leaf, axis=1)
+        if "leaf_value_f16" in stk:
+            # compact plan: de-quantize leaves to f32 at the gather, so
+            # the per-class accumulation runs full-precision
+            vals = jnp.take_along_axis(stk["leaf_value_f16"], leaf,
+                                       axis=1).astype(jnp.float32)
+        else:
+            vals = jnp.take_along_axis(stk["leaf_value_f32"], leaf, axis=1)
         acc = jnp.zeros((self.num_class, vals.shape[1]), jnp.float32)
         acc = acc.at[stk["tree_class"]].add(vals)
         return acc, leaf
@@ -418,6 +612,7 @@ class ForestEngine:
         the chunk loop inside the jit (`lax.scan`) so small microchunks —
         which keep the [T, C] frontier cache-resident — cost no dispatch."""
         self.compile_count += 1
+        compile_cache.note_trace()
         bt = planes[0]                                   # [F, bucket] uint8
         t_count = self.num_trees
         s, b, k = self._route_slots, self._route_bins, self._route_kbits
@@ -469,6 +664,15 @@ class ForestEngine:
         nanmask = np.isnan(X)
         Xz = np.where(nanmask, 0.0, X)
         Xz = np.where(Xz == 0.0, 0.0, Xz)             # -0.0 -> +0.0
+        if self.compact != "off":
+            # compact routing compares plain f32 values, not key planes
+            planes = [np.ascontiguousarray(Xz.T.astype(np.float32)),
+                      np.ascontiguousarray(nanmask.T)]
+            if self.has_cat:
+                iv = np.where(Xz < 0, -1.0,
+                              np.minimum(np.trunc(Xz), float(2 ** 31 - 2)))
+                planes.append(np.ascontiguousarray(iv.T.astype(np.int32)))
+            return tuple(planes)
         hi, lo = _f64_key_planes(Xz)
         planes = [np.ascontiguousarray(hi.T), np.ascontiguousarray(lo.T),
                   np.ascontiguousarray(nanmask.T)]
@@ -490,12 +694,49 @@ class ForestEngine:
             return p
         return np.pad(p, ((0, 0), (0, width - m)))
 
-    def predict(self, X, pred_leaf: bool = False
+    def _es_segments(self, freq: int) -> list:
+        """Device sub-stacks [t0, t1) for chunked early-exit: tree-axis
+        slices of the resident arrays (zero-copy views on CPU; a slice of
+        a device array on TPU). Shared planes (`cat_words`, the int8
+        per-feature scales) stay whole — `cat_start` offsets index the
+        global bitset."""
+        freq = max(int(freq), 1)
+        if freq not in self._es_cache:
+            shared = ("cat_words", "thr_scale", "thr_off")
+            segs = []
+            t0 = 0
+            while t0 < self.num_trees:
+                t1 = min(t0 + freq, self.num_trees)
+                sub = {k: (v if k in shared else v[t0:t1])
+                       for k, v in self._stk.items()}
+                segs.append(sub)
+                t0 = t1
+            self._es_cache[freq] = segs
+        return self._es_cache[freq]
+
+    def _es_satisfied(self, acc: np.ndarray, margin: float) -> bool:
+        """Reference `prediction_early_stop.cpp`: binary stops when every
+        row's |margin| clears the threshold, multiclass when every row's
+        top1-top2 gap does. Chunk-granular — the whole chunk must agree
+        before the remaining trees are skipped."""
+        if self.num_class == 1:
+            return bool(np.all(np.abs(acc) > margin))
+        part = np.sort(acc, axis=0)
+        return bool(np.all(part[-1] - part[-2] > margin))
+
+    def predict(self, X, pred_leaf: bool = False,
+                early_stop: Optional[Tuple[int, float]] = None
                 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Score a batch. Returns (margins [N, num_class] f64,
         leaves [N, T] int32 or None). Large batches stream through
         fixed-size chunks; small ones pad to a power-of-two bucket, so any
-        N inside a bucket reuses the same compiled program."""
+        N inside a bucket reuses the same compiled program.
+
+        `early_stop=(freq_trees, margin)` scores the forest in
+        `freq_trees`-tree segments and skips the remainder once the whole
+        chunk clears the margin criterion (reference
+        `prediction_early_stop.cpp` semantics, chunk-granular).
+        """
         from .. import compile_cache
         from ..obs import trace as obs_trace
         from ..utils import log
@@ -504,6 +745,8 @@ class ForestEngine:
         acc = np.empty((n, self.num_class), np.float64)
         leaves = np.empty((n, self.num_trees), np.int32) if pred_leaf \
             else None
+        if pred_leaf:
+            early_stop = None           # leaf ids need every tree
         step = self.chunk_rows
         self.predict_calls += 1
         with obs_trace.span("serve.predict", rows=n,
@@ -515,11 +758,35 @@ class ForestEngine:
                 chunk = tuple(self._pad_cols(p[:, lo:hi], bucket)
                               for p in planes)
                 cc0 = self.compile_count
+                aot_fn = (self._aot_calls.get(bucket)
+                          if early_stop is None and self._route is None
+                          else None)
                 with obs_trace.span("serve.score", bucket=bucket,
                                     rows=m), \
                         compile_cache.attribution(
                             f"serve:T{self.num_trees}:b{bucket}"):
-                    if self._route is not None and not pred_leaf:
+                    if early_stop is not None and self._route is None:
+                        out = self._predict_early_stop(chunk, m, early_stop)
+                    elif aot_fn is not None:
+                        # deserialized export: dispatch never re-runs the
+                        # _run body, so note_trace/compile_count stay put
+                        try:
+                            out, lf = aot_fn(self._stk, chunk)
+                            self.aot_hits += 1
+                        except ValueError:
+                            # caller planes disagree with the exported
+                            # avals (e.g. fewer feature rows than the
+                            # artifact was traced with): retire the
+                            # bucket's program and serve via the engine
+                            # jit — identical to a cold process
+                            self._aot_calls.pop(bucket, None)
+                            log.event("serve_aot",
+                                      status="shape_mismatch",
+                                      bucket=bucket)
+                            out, lf = self._jit_run(self._stk, chunk)
+                        if pred_leaf:
+                            leaves[lo:hi] = np.asarray(lf)[:, :m].T
+                    elif self._route is not None and not pred_leaf:
                         out = self._jit_run_routed(self._route, chunk)
                     else:
                         out, lf = self._jit_run(self._stk, chunk)
@@ -534,6 +801,22 @@ class ForestEngine:
                               compile_count=self.compile_count)
                 acc[lo:hi] = np.asarray(out)[:, :m].T
         return acc, leaves
+
+    def _predict_early_stop(self, chunk, m: int,
+                            early_stop: Tuple[int, float]) -> np.ndarray:
+        freq, margin = early_stop
+        segs = self._es_segments(freq)
+        total = np.zeros((self.num_class, chunk[0].shape[1]), np.float32)
+        for si, sub in enumerate(segs):
+            out, _ = self._jit_run(sub, chunk)
+            total += np.asarray(out)
+            if si < len(segs) - 1 and self._es_satisfied(
+                    total[:, :m], margin):
+                self.early_stop_exits += 1
+                from ..obs import metrics as obs_metrics
+                obs_metrics.note_early_stop()
+                break
+        return total
 
     # -- bulk row-sharded scoring -----------------------------------------
     def predict_sharded(self, X, devices=None) -> np.ndarray:

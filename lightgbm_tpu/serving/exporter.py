@@ -8,7 +8,9 @@ A stdlib `ThreadingHTTPServer` (no new dependencies) bound to
   including per-model latency histograms with interpolated _p50/_p99
   series and the HBM accountant gauges;
 * ``GET /metrics.json``  — the versioned snapshot dict (registry +
-  memory reconciliation) for tooling that prefers JSON;
+  memory reconciliation) for tooling that prefers JSON, plus a
+  ``serving`` block with per-model AOT artifact state and compact-plan
+  bytes saved when a model registry is attached;
 * ``GET /debug/requests`` — the request tracer's live view (recent
   ring, slowest-request table, burn rates) when ``tpu_serve_trace`` is
   on; ``{"enabled": false}`` otherwise.
@@ -77,9 +79,12 @@ class MetricsExporter:
     """HTTP scrape endpoint over the process metrics registry."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 tracer=None) -> None:
+                 tracer=None, registry=None) -> None:
         obs_metrics.enable()
         self.tracer = tracer
+        # model registry (serving/registry.py): when attached,
+        # /metrics.json carries per-model AOT + compaction detail
+        self.registry = registry
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True
@@ -96,9 +101,13 @@ class MetricsExporter:
         return obs_metrics.to_prometheus()
 
     def render_json(self) -> Dict[str, Any]:
-        return {"schema": obs_metrics.SCHEMA_VERSION,
-                "metrics": obs_metrics.snapshot(),
-                "memory": obs_memory.snapshot()}
+        doc = {"schema": obs_metrics.SCHEMA_VERSION,
+               "metrics": obs_metrics.snapshot(),
+               "memory": obs_memory.snapshot()}
+        if self.registry is not None:
+            doc["serving"] = {
+                "models": self.registry.aot_compact_stats()}
+        return doc
 
     def render_requests(self) -> Dict[str, Any]:
         """The /debug/requests document (request-trace ring + slow

@@ -70,7 +70,8 @@ class ModelEntry:
     """One resident model: its engine plus accounting the registry needs."""
 
     __slots__ = ("name", "engine", "num_class", "num_features", "bytes",
-                 "version", "source", "loaded_at", "hits", "buckets")
+                 "version", "source", "loaded_at", "hits", "buckets",
+                 "compact", "aot_buckets")
 
     def __init__(self, name: str, engine: ForestEngine, num_class: int,
                  num_features: int, version: str, source: str) -> None:
@@ -84,6 +85,8 @@ class ModelEntry:
         self.loaded_at = time.time()
         self.hits = 0
         self.buckets: set = set()
+        self.compact = engine.compact    # plan actually in effect
+        self.aot_buckets = 0             # AOT shape buckets attached
 
     def warm(self, rows: int) -> None:
         """Trace + compile the engine's program for the pow2 bucket that
@@ -103,10 +106,17 @@ class ModelRegistry:
     """Named ForestEngine pool with HBM-budget LRU eviction."""
 
     def __init__(self, hbm_budget_mb: float = 0.0, warm_rows: int = 256,
-                 ledger=None, tracer=None) -> None:
+                 ledger=None, tracer=None, compact: str = "off",
+                 compact_tol: float = 0.05, aot_dir: str = "") -> None:
         self.hbm_budget_bytes = int(max(float(hbm_budget_mb), 0.0) * 2**20)
         self.warm_rows = int(warm_rows)
         self.ledger = ledger
+        # compact residency plan (tpu_serve_compact) applied to every
+        # load, behind the parity gate; aot_dir (tpu_serve_aot_dir)
+        # points at serve/aot.py artifacts attached at load time
+        self.compact = compact
+        self.compact_tol = float(compact_tol)
+        self.aot_dir = aot_dir
         # request tracer (obs/reqtrace.py): load/swap/evict notes also
         # land as MARKER rows in its ring so /debug/requests interleaves
         # registry churn with the requests it slowed down
@@ -145,6 +155,55 @@ class ModelRegistry:
             self._tracer.note(kind, **fields)
 
     # -- building ----------------------------------------------------------
+    def _compact_parity(self, engine: ForestEngine, trees, k: int,
+                        nfeat: int):
+        """(abs_err, rel_err) of the compact engine vs the f64 host
+        oracle over a deterministic probe batch whose rows span each
+        feature's split-threshold range (random WITHIN the ranges, never
+        pinned exactly AT a threshold — quantization legitimately moves
+        the decision boundary by <= half a step; the gate measures margin
+        drift, not boundary placement)."""
+        import numpy as np
+
+        from ..ops.predict import predict_raw_values
+        lo = np.full(nfeat, np.inf)
+        hi = np.full(nfeat, -np.inf)
+        for t in trees:
+            if t.num_leaves <= 1:
+                continue
+            dt = np.asarray(t.decision_type, np.int32)
+            num = (dt & 1) == 0
+            sf = np.asarray(t.split_feature)[num]
+            th = np.asarray(t.threshold, np.float64)[num]
+            np.minimum.at(lo, sf, th)
+            np.maximum.at(hi, sf, th)
+        unused = ~np.isfinite(lo)
+        lo[unused] = 0.0
+        hi[unused] = 1.0
+        span = np.maximum(hi - lo, 1.0)
+        rng = np.random.RandomState(0)
+        X = (lo + (hi - lo) * rng.rand(128, nfeat)
+             + (rng.rand(128, nfeat) - 0.5) * 0.25 * span)
+        oracle = np.stack([predict_raw_values(trees[c::k], X)
+                           for c in range(k)], axis=1)
+        got, _ = engine.predict(X)
+        err = float(np.max(np.abs(got - oracle)))
+        return err, err / max(1.0, float(np.max(np.abs(oracle))))
+
+    def _attach_aot(self, engine: ForestEngine, name: str,
+                    nfeat: int) -> int:
+        """Attach AOT artifact buckets when tpu_serve_aot_dir is set:
+        a per-model subdirectory (`<aot_dir>/<name>/`) wins over a shared
+        single-model artifact at the directory root."""
+        if not self.aot_dir:
+            return 0
+        from ..serve import aot
+        sub = os.path.join(self.aot_dir, name)
+        d = (sub if os.path.isfile(os.path.join(sub,
+                                                aot.ARTIFACT_MANIFEST))
+             else self.aot_dir)
+        return aot.load_artifact(engine, d, nfeat, model=name)
+
     def _build_entry(self, name: str, model_str: str, version: str,
                      source: str, warm_rows: Optional[int]) -> ModelEntry:
         loaded = load_model_from_string(model_str)
@@ -152,12 +211,30 @@ class ModelRegistry:
         if not trees:
             raise ValueError(f"model {name!r} ({source}) has no trees")
         k = int(loaded.get("num_tree_per_iteration", 1))
-        engine = ForestEngine(trees, num_class=k, mode="raw")
         nfeat = int(loaded.get("max_feature_idx", -1)) + 1
         if nfeat <= 0:
             nfeat = int(max(t.split_feature.max() if t.num_leaves > 1 else 0
                             for t in trees)) + 1
+        engine = ForestEngine(trees, num_class=k, mode="raw",
+                              compact=self.compact)
+        if self.compact != "off":
+            err, rel = self._compact_parity(engine, trees, k, nfeat)
+            if rel > self.compact_tol:
+                # parity gate failed: keep correctness, lose density —
+                # the f32 engine replaces the compact one and the
+                # structured event says exactly why
+                self._note("serve_compact_fallback", model=name,
+                           plan=self.compact, err=err, rel_err=rel,
+                           tol=self.compact_tol)
+                engine = ForestEngine(trees, num_class=k, mode="raw")
+            else:
+                self._note("serve_compact", model=name, plan=self.compact,
+                           err=err, rel_err=rel,
+                           bytes=engine.device_bytes(),
+                           f32_bytes=engine.f32_device_bytes())
+        aot_n = self._attach_aot(engine, name, nfeat)
         entry = ModelEntry(name, engine, k, nfeat, version, source)
+        entry.aot_buckets = aot_n
         rows = self.warm_rows if warm_rows is None else int(warm_rows)
         if rows > 0:
             entry.warm(rows)
@@ -269,7 +346,12 @@ class ModelRegistry:
                                "trees": e.engine.num_trees,
                                "compile_count": e.engine.compile_count,
                                "cache_hits": e.engine.cache_hits,
-                               "predict_calls": e.engine.predict_calls}
+                               "predict_calls": e.engine.predict_calls,
+                               "compact": e.compact,
+                               "aot_buckets": e.aot_buckets,
+                               "aot_hits": e.engine.aot_hits,
+                               "early_stop_exits":
+                                   e.engine.early_stop_exits}
                            for n, e in self._entries.items()},
                 "total_bytes": sum(e.bytes
                                    for e in self._entries.values()),
@@ -279,6 +361,25 @@ class ModelRegistry:
                 "evictions": self.evictions,
                 "evicted": list(self.evicted),
             }
+
+    def aot_compact_stats(self) -> Dict[str, Any]:
+        """Per-model AOT + compaction detail for the metrics exporter's
+        /metrics.json `serving` block: artifact hit state and the bytes
+        a compact plan saved vs its f32 counterfactual."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for n, e in self._entries.items():
+                f32_bytes = e.engine.f32_device_bytes()
+                out[n] = {
+                    "aot": {"buckets": e.aot_buckets,
+                            "hits": e.engine.aot_hits,
+                            "source": e.engine.aot_source},
+                    "compact": {"plan": e.compact, "bytes": e.bytes,
+                                "f32_bytes": f32_bytes,
+                                "bytes_saved": max(f32_bytes - e.bytes,
+                                                   0)},
+                }
+            return out
 
     # -- eviction ----------------------------------------------------------
     def _touch(self, name: str) -> None:  # guarded-by: caller

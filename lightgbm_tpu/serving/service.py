@@ -58,7 +58,10 @@ class ServingService:
         self.registry = ModelRegistry(
             hbm_budget_mb=cfg.tpu_serve_hbm_budget_mb,
             warm_rows=cfg.tpu_serve_warm_rows,
-            ledger=ledger, tracer=self.tracer)
+            ledger=ledger, tracer=self.tracer,
+            compact=cfg.tpu_serve_compact,
+            compact_tol=cfg.tpu_serve_compact_tol,
+            aot_dir=cfg.tpu_serve_aot_dir)
         self.coalescer = RequestCoalescer(
             self.registry,
             max_batch_wait_ms=cfg.tpu_serve_max_batch_wait_ms,
@@ -67,7 +70,8 @@ class ServingService:
         if cfg.tpu_serve_metrics_port:
             from .exporter import MetricsExporter
             self.exporter = MetricsExporter(cfg.tpu_serve_metrics_port,
-                                            tracer=self.tracer)
+                                            tracer=self.tracer,
+                                            registry=self.registry)
         self._watchers: Dict[str, CheckpointWatcher] = {}
         self._closed = False
 

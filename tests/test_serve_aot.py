@@ -1,0 +1,488 @@
+"""AOT serving artifacts (`serve/aot.py`) + compact quantized forests
+(`ForestEngine` compact dtype plans) + chunked prediction early exit.
+
+Contracts under test: an exported artifact re-attaches to a fresh engine
+and reaches first score with ZERO new jax traces; any signature drift is
+a clean rebuild (never a crash, never a silently-wrong program); the
+f16/int8 plans route identically to f32 wherever feature values clear
+the quantization error of the thresholds, and the registry's parity
+gate guards the rest (structured `serve_compact_fallback`, never silent
+drift); compact residency at least doubles model density under a fixed
+HBM budget; `pred_early_stop` on the batched engine path is exact when
+the margin is never met and counts its chunk exits when it is.
+
+Boosters are memoized per config (read-only in every test) and
+registries that are not exercising warm-up run with `warm_rows=0`, so
+the fast tier stays cheap; the wider sweeps (watcher hot swap, registry
+artifact attach, multiclass legs) carry the `slow` marker — `ci/test.sh`
+drives the same paths end-to-end through real `task=serve` processes.
+"""
+import json
+import os
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import compile_cache
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.ops.predict import predict_raw_values
+from lightgbm_tpu.serve import (COMPACT_PLANS, ForestEngine, aot,
+                                compact_stack, stack_forest)
+from lightgbm_tpu.serving import CheckpointWatcher, ModelRegistry
+from lightgbm_tpu.utils.log import (parse_event, register_callback,
+                                    set_verbosity)
+
+HAS_EXPORT = aot._export_module() is not None
+needs_export = pytest.mark.skipif(
+    not HAS_EXPORT, reason="this jax has no jax.export serialization")
+
+_BOOSTERS = {}
+
+
+def _train(n=500, f=8, seed=0, num_class=1, iters=5):
+    """Train-once-per-config booster cache; callers treat the booster
+    and matrix as read-only."""
+    key = ("normal", n, f, seed, num_class, iters)
+    if key not in _BOOSTERS:
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, f))
+        if num_class > 1:
+            y = rng.integers(0, num_class, n).astype(float)
+            params = {"objective": "multiclass", "num_class": num_class,
+                      "num_leaves": 6}
+        else:
+            y = ((X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+                  + 0.3 * rng.normal(size=n)) > 0).astype(float)
+            params = {"objective": "binary", "num_leaves": 8}
+        params.update({"verbose": -1, "min_data_in_leaf": 10})
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=iters, keep_training_booster=True)
+        _BOOSTERS[key] = (bst, X, y)
+    return _BOOSTERS[key]
+
+
+def _train_rand(seed=0, n=500, f=8, rounds=8):
+    """Boosters over rand[0,1) features: threshold spans ~1, so the
+    registry's f16 parity gate passes comfortably (quantization error
+    ~2**-11 against unit-scale thresholds).  Shapes (n, f, num_leaves)
+    deliberately match _train so the training program compile is reused."""
+    key = ("rand", n, f, seed, rounds)
+    if key not in _BOOSTERS:
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, f)
+        y = (X[:, 0] + 0.3 * rng.rand(n) > 0.6).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 10,
+             "verbosity": -1, "seed": seed}
+        _BOOSTERS[key] = (lgb.train(p, lgb.Dataset(X, label=y),
+                                    num_boost_round=rounds), X)
+    return _BOOSTERS[key]
+
+
+def _host_margin(bst, X):
+    k = bst.num_tree_per_iteration
+    out = np.zeros((len(X), k))
+    for c in range(k):
+        out[:, c] = predict_raw_values(bst.trees[c::k], X)
+    return out
+
+
+@pytest.fixture
+def events():
+    lines = []
+    register_callback(lines.append)
+    set_verbosity(1)
+    yield lambda kind: [r for r in map(parse_event, lines)
+                        if r and r["event"] == kind]
+    register_callback(None)
+    set_verbosity(1)
+
+
+# ------------------------------------------------------------------ AOT
+
+@needs_export
+def test_aot_export_attach_zero_traces(tmp_path):
+    bst, X, _ = _train()
+    src = ForestEngine(bst.trees, mode="raw")
+    want, want_leaves = src.predict(X, pred_leaf=True)
+    manifest = aot.export_artifact(src, str(tmp_path), [256, 512],
+                                   X.shape[1])
+    assert manifest["kind"] == "export"
+    assert sorted(manifest["buckets"]) == ["256", "512"]
+    for name in manifest["buckets"].values():
+        assert os.path.getsize(os.path.join(str(tmp_path), name)) > 0
+
+    fresh = ForestEngine(bst.trees, mode="raw")
+    assert aot.load_artifact(fresh, str(tmp_path), X.shape[1]) == 2
+    t0 = compile_cache.trace_count()
+    got, got_leaves = fresh.predict(X, pred_leaf=True)
+    assert compile_cache.trace_count() == t0, \
+        "AOT-attached engine traced a program before first score"
+    assert fresh.compile_count == 0
+    assert fresh.aot_hits >= 1
+    assert fresh.aot_source == str(tmp_path)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got_leaves, want_leaves)
+
+
+@pytest.mark.slow
+@needs_export
+def test_aot_uncovered_bucket_falls_back_to_jit(tmp_path):
+    """An artifact restricted to bucket 256 leaves bucket 512 to the
+    engine's own jit: an incomplete artifact is slower, never wrong."""
+    bst, X, _ = _train()
+    src = ForestEngine(bst.trees, mode="raw")
+    aot.export_artifact(src, str(tmp_path), [256], X.shape[1])
+    partial = ForestEngine(bst.trees, mode="raw")
+    assert aot.load_artifact(partial, str(tmp_path), X.shape[1]) == 1
+    got, _ = partial.predict(X)               # 500 rows -> bucket 512
+    assert partial.compile_count == 1         # own jit covered the miss
+    np.testing.assert_array_equal(got, src.predict(X)[0])
+
+
+@pytest.mark.slow
+@needs_export
+def test_aot_plane_shape_mismatch_retires_program(tmp_path, events):
+    """Caller rows with fewer feature columns than the artifact was traced
+    with must not crash the request: the bucket's exported program is
+    retired (loud serve_aot shape_mismatch event) and the chunk is served
+    by the engine jit, matching a cold process exactly."""
+    bst, X, _ = _train()
+    src = ForestEngine(bst.trees, mode="raw")
+    aot.export_artifact(src, str(tmp_path), [512], X.shape[1])
+    eng = ForestEngine(bst.trees, mode="raw")
+    assert aot.load_artifact(eng, str(tmp_path), X.shape[1]) == 1
+    narrow = X[:, :-1]                        # one feature column short
+    set_verbosity(1)
+    got, _ = eng.predict(narrow)
+    evs = [e for e in events("serve_aot")
+           if e.get("status") == "shape_mismatch"]
+    assert len(evs) == 1 and evs[0]["bucket"] == 512, evs
+    assert not eng._aot_calls                 # program retired, not retried
+    assert eng.compile_count == 1             # served via the engine jit
+    cold = ForestEngine(bst.trees, mode="raw")
+    np.testing.assert_array_equal(got, cold.predict(narrow)[0])
+
+
+@needs_export
+def test_aot_signature_mismatch_is_clean_rebuild(tmp_path, events):
+    bst_a, X, _ = _train(iters=5)
+    bst_b, _, _ = _train(iters=7)             # different num_trees
+    aot.export_artifact(ForestEngine(bst_a.trees, mode="raw"),
+                        str(tmp_path), [512], X.shape[1])
+    set_verbosity(1)
+    eng_b = ForestEngine(bst_b.trees, mode="raw")
+    assert aot.load_artifact(eng_b, str(tmp_path), X.shape[1]) == 0
+    evs = [e for e in events("serve_aot")
+           if e["status"] == "signature_mismatch"]
+    assert evs and "num_trees" in evs[0]["mismatch"]
+    got, _ = eng_b.predict(X)                 # engine's own jit still fine
+    np.testing.assert_allclose(got[:, 0], _host_margin(bst_b, X)[:, 0],
+                               rtol=1e-5, atol=1e-5)
+    # the compact dtype plan is part of the signature too
+    plain_sig = aot.artifact_signature(
+        ForestEngine(bst_a.trees, mode="raw"), X.shape[1])
+    f16_sig = aot.artifact_signature(
+        ForestEngine(bst_a.trees, mode="raw", compact="f16"), X.shape[1])
+    assert "compact" in aot._signature_diff(plain_sig, f16_sig)
+    assert "stack" in aot._signature_diff(plain_sig, f16_sig)
+
+
+def test_aot_missing_and_corrupt_artifacts(tmp_path, events):
+    bst, X, _ = _train()
+    eng = ForestEngine(bst.trees, mode="raw")
+    set_verbosity(1)
+    assert aot.load_artifact(eng, str(tmp_path / "nowhere"),
+                             X.shape[1]) == 0
+    assert any(e["status"] == "miss" for e in events("serve_aot"))
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / aot.ARTIFACT_MANIFEST).write_text("{half a manifest")
+    assert aot.load_artifact(eng, str(bad), X.shape[1]) == 0
+    assert any(e["status"] == "bad_manifest" for e in events("serve_aot"))
+    if HAS_EXPORT:
+        # real manifest, truncated blob: skipped bucket, no attach
+        aot.export_artifact(ForestEngine(bst.trees, mode="raw"),
+                            str(tmp_path), [512], X.shape[1])
+        blob = tmp_path / "bucket_512.bin"
+        blob.write_bytes(blob.read_bytes()[:16])
+        assert aot.load_artifact(eng, str(tmp_path), X.shape[1]) == 0
+        assert any(e["status"] == "bad_blob" for e in events("serve_aot"))
+
+
+@pytest.mark.slow
+@needs_export
+def test_registry_attaches_artifact_and_serves_without_compiling(
+        tmp_path, events):
+    bst, X = _train_rand()
+    model_str = bst.model_to_string()
+    # export with the exact engine a registry builds for this model
+    donor = ModelRegistry().load("m", model_str=model_str).engine
+    aot.export_artifact(donor, str(tmp_path), [256, 512], X.shape[1])
+    set_verbosity(1)
+    reg = ModelRegistry(aot_dir=str(tmp_path))
+    entry = reg.load("m", model_str=model_str)   # warm-up rides the artifact
+    assert entry.aot_buckets == 2
+    got, _ = entry.engine.predict(X)
+    assert entry.engine.compile_count == 0
+    assert entry.engine.aot_hits >= 1
+    np.testing.assert_array_equal(got, donor.predict(X)[0])
+    assert reg.stats()["models"]["m"]["aot_buckets"] == 2
+    ac = reg.aot_compact_stats()["m"]
+    assert ac["aot"]["buckets"] == 2 and ac["aot"]["hits"] >= 1
+    assert any(e["status"] == "hit" for e in events("serve_aot"))
+    # per-model subdir <aot_dir>/<name>/ wins over the root
+    sub_root = tmp_path / "by_model"
+    aot.export_artifact(donor, str(sub_root / "m"), [256], X.shape[1])
+    reg2 = ModelRegistry(aot_dir=str(sub_root), warm_rows=0)
+    assert reg2.load("m", model_str=model_str).aot_buckets == 1
+
+
+# ------------------------------------------------- compact dtype plans
+
+def _thresholds_by_feature(trees):
+    out = defaultdict(list)
+    for t in trees:
+        for i in range(int(t.num_leaves) - 1):
+            if (int(t.decision_type[i]) & 1) == 0:
+                out[int(t.split_feature[i])].append(float(t.threshold[i]))
+    return out
+
+
+def _rows_clear_of_thresholds(trees, X, clearance):
+    """Rows whose every feature value sits at least `clearance` away from
+    every numerical threshold: quantized-threshold routing is provably
+    identical to f32 routing there."""
+    keep = np.ones(len(X), bool)
+    for f, ts in _thresholds_by_feature(trees).items():
+        d = np.abs(X[:, f][:, None] - np.asarray(ts)[None, :]).min(axis=1)
+        keep &= d > clearance
+    return X[keep]
+
+
+def test_compact_stack_shapes_and_plans():
+    bst, X, _ = _train()
+    host = stack_forest(bst.trees, 1)
+    assert COMPACT_PLANS == ("off", "f16", "int8")
+    f16 = compact_stack(host, "f16")
+    assert f16["thr_f16"].dtype == np.float16
+    assert f16["leaf_value_f16"].dtype == np.float16
+    assert f16["split_feature"].dtype == np.int16   # narrowed topology
+    q = compact_stack(host, "int8")
+    assert q["thr_q"].dtype == np.int8
+    assert q["thr_scale"].dtype == np.float32
+    assert q["thr_scale"].shape == (X.shape[1],)
+    with pytest.raises(ValueError):
+        compact_stack(host, "float8")
+    with pytest.raises(ValueError):
+        ForestEngine(bst.trees, mode="raw", compact="float8")
+    with pytest.raises(ValueError):
+        ForestEngine(bst.trees, mode="binned", compact="f16")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("plan,clearance,vtol",
+                         [("f16", 0.01, 5e-3), ("int8", 0.08, 5e-3)])
+def test_compact_routing_identical_off_the_boundary(plan, clearance, vtol):
+    """Threshold round-trip: wherever rows clear the plan's quantization
+    error, compact routing is leaf-identical to f32 and margins differ
+    only by f16 leaf-value rounding."""
+    bst, X, _ = _train(n=400, iters=3)
+    rng = np.random.default_rng(11)
+    probe = rng.normal(size=(1200, X.shape[1]))
+    probe = _rows_clear_of_thresholds(bst.trees, probe, clearance)
+    assert len(probe) >= 50, "threshold clearance filter ate the probe"
+    full = ForestEngine(bst.trees, mode="raw")
+    comp = ForestEngine(bst.trees, mode="raw", compact=plan)
+    assert comp.compact == plan
+    m_full, l_full = full.predict(probe, pred_leaf=True)
+    m_comp, l_comp = comp.predict(probe, pred_leaf=True)
+    np.testing.assert_array_equal(l_comp, l_full)
+    np.testing.assert_allclose(m_comp, m_full, atol=vtol, rtol=1e-3)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("plan", ["f16", "int8"])
+def test_compact_parity_vs_host_walk_on_unit_scale_data(plan):
+    bst, X = _train_rand()
+    comp = ForestEngine(bst.trees, mode="raw", compact=plan)
+    got = comp.predict(X)[0][:, 0]
+    want = predict_raw_values(bst.trees, X)
+    scale = max(1.0, float(np.abs(want).max()))
+    frac_off = np.mean(np.abs(got - want) / scale > 0.05)
+    assert frac_off < 0.05, \
+        f"{plan}: {frac_off:.1%} of rows off by >5% of margin scale"
+
+
+@pytest.mark.slow
+def test_compact_nan_and_multiclass_routing():
+    bst, X, _ = _train(num_class=3, iters=4)
+    Xn = X.copy()
+    Xn[::7, 2] = np.nan
+    full = ForestEngine(bst.trees, num_class=3, mode="raw")
+    comp = ForestEngine(bst.trees, num_class=3, mode="raw", compact="f16")
+    m_full, l_full = full.predict(Xn, pred_leaf=True)
+    m_comp, l_comp = comp.predict(Xn, pred_leaf=True)
+    assert m_comp.shape == m_full.shape == (len(Xn), 3)
+    # NaN rows take default-direction routing in both plans
+    same = np.mean(l_comp == l_full)
+    assert same > 0.99, f"only {same:.1%} of leaf routes agree"
+
+
+@pytest.mark.parametrize("plan", ["f16", "int8"])
+def test_compact_density_at_least_2x(plan):
+    bst, _, _ = _train(iters=10)
+    full = ForestEngine(bst.trees, mode="raw")
+    comp = ForestEngine(bst.trees, mode="raw", compact=plan)
+    assert comp.f32_device_bytes() == full.device_bytes()
+    density = full.device_bytes() / comp.device_bytes()
+    assert density >= 2.0, f"{plan} density {density:.2f}x < 2x"
+
+
+# ------------------------------------------- registry gate + density
+
+@pytest.mark.slow
+def test_registry_compact_pass_event_and_stats(events):
+    bst, X = _train_rand()
+    set_verbosity(1)
+    reg = ModelRegistry(compact="f16", warm_rows=0)
+    entry = reg.load("m", model_str=bst.model_to_string())
+    assert entry.compact == "f16"
+    evs = events("serve_compact")
+    assert len(evs) == 1 and evs[0]["model"] == "m"
+    assert evs[0]["bytes"] < evs[0]["f32_bytes"]
+    assert reg.stats()["models"]["m"]["compact"] == "f16"
+    ac = reg.aot_compact_stats()["m"]["compact"]
+    assert ac["plan"] == "f16" and ac["bytes_saved"] > 0
+    assert ac["f32_bytes"] >= 2 * ac["bytes"]
+
+
+def test_registry_parity_gate_falls_back_not_drifts(events):
+    bst, X = _train_rand()
+    set_verbosity(1)
+    plain = ModelRegistry(warm_rows=0).load(
+        "p", model_str=bst.model_to_string())
+    reg = ModelRegistry(compact="f16", compact_tol=1e-12, warm_rows=0)
+    entry = reg.load("m", model_str=bst.model_to_string())
+    evs = events("serve_compact_fallback")
+    assert len(evs) == 1
+    assert evs[0]["plan"] == "f16" and evs[0]["tol"] == 1e-12
+    assert evs[0]["err"] >= 0 and evs[0]["rel_err"] >= 0
+    # the fallback engine IS the f32 engine: bit-identical scores
+    assert entry.compact == "off"
+    assert reg.stats()["models"]["m"]["compact"] == "off"
+    np.testing.assert_array_equal(entry.engine.predict(X)[0],
+                                  plain.engine.predict(X)[0])
+
+
+@pytest.mark.slow
+def test_registry_compact_doubles_model_density():
+    """Under a budget sized for ~1.2 f32 models, the f32 registry
+    thrashes at one resident model while the compact registry holds two:
+    >=2x density from the same HBM (two tenants of one model text are
+    enough — the LRU only sees bytes)."""
+    b1, X = _train_rand()
+    f32_bytes = ModelRegistry(warm_rows=0).load(
+        "probe", model_str=b1.model_to_string()).bytes
+    budget_mb = 1.2 * f32_bytes / 2 ** 20
+
+    f32_reg = ModelRegistry(hbm_budget_mb=budget_mb, warm_rows=0)
+    f32_reg.load("a", model_str=b1.model_to_string())
+    f32_reg.load("b", model_str=b1.model_to_string())
+    assert f32_reg.stats()["evictions"] == 1
+    assert sorted(f32_reg.stats()["models"]) == ["b"]
+
+    c_reg = ModelRegistry(hbm_budget_mb=budget_mb, compact="f16",
+                          warm_rows=0)
+    c_reg.load("a", model_str=b1.model_to_string())
+    c_reg.load("b", model_str=b1.model_to_string())
+    st = c_reg.stats()
+    assert st["evictions"] == 0
+    assert sorted(st["models"]) == ["a", "b"]    # both resident
+    assert st["total_bytes"] <= f32_reg.hbm_budget_bytes
+
+
+@pytest.mark.slow
+def test_watcher_hot_swaps_compact_model(tmp_path, events):
+    b1, X = _train_rand(seed=3)
+    b2, _ = _train_rand(seed=4, rounds=10)
+    set_verbosity(1)
+    d = str(tmp_path)
+    reg = ModelRegistry(compact="f16", warm_rows=0)
+    w = CheckpointWatcher(reg, "m", d, interval_s=0.01)
+
+    def publish(version, bst):
+        vd = os.path.join(d, version)
+        os.makedirs(vd, exist_ok=True)
+        with open(os.path.join(vd, "model.txt"), "w") as fh:
+            fh.write(bst.model_to_string())
+        tmp = os.path.join(d, "MANIFEST.json.tmp")
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps({"latest": version, "round": 1}))
+        os.replace(tmp, os.path.join(d, "MANIFEST.json"))
+
+    publish("ckpt_000001", b1)
+    assert w.poll_once() is True
+    publish("ckpt_000002", b2)
+    assert w.poll_once() is True
+    entry = reg.acquire("m")
+    assert entry.version == "ckpt_000002"
+    assert entry.compact == "f16"
+    # the swapped-in compact engine == a cold compact load of the same model
+    cold = ModelRegistry(compact="f16", warm_rows=0).load(
+        "cold", model_str=b2.model_to_string())
+    np.testing.assert_array_equal(entry.engine.predict(X)[0],
+                                  cold.engine.predict(X)[0])
+    assert len(events("serve_compact")) == 3      # two swaps + cold twin
+
+
+# ------------------------------------------------- prediction early exit
+
+def test_early_stop_unmet_margin_is_exact():
+    bst, X, _ = _train(iters=16)
+    eng = ForestEngine(bst.trees, mode="raw")
+    want, _ = eng.predict(X)
+    got, _ = eng.predict(X, early_stop=(8, 1e9))   # margin never met
+    assert eng.early_stop_exits == 0
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_early_stop_exits_and_counts_chunks():
+    bst, X, _ = _train(iters=16)
+    eng = ForestEngine(bst.trees, mode="raw", chunk_rows=128)
+    obs_metrics.reset()
+    obs_metrics.enable()
+    try:
+        got, _ = eng.predict(X, early_stop=(4, 1e-9))
+        assert got.shape == (len(X), 1)
+        assert eng.early_stop_exits >= 1
+        snap = obs_metrics.snapshot()["counters"]
+        assert snap["serve_early_stop_total"] == eng.early_stop_exits
+    finally:
+        obs_metrics.disable()
+        obs_metrics.reset()
+    # exits are per chunk, bounded by chunk count
+    assert eng.early_stop_exits <= -(-len(X) // 128)
+
+
+@pytest.mark.slow
+def test_early_stop_multiclass_top_gap_semantics():
+    bst, X, _ = _train(num_class=3, iters=12)
+    eng = ForestEngine(bst.trees, num_class=3, mode="raw")
+    want, _ = eng.predict(X)
+    got, _ = eng.predict(X, early_stop=(4, 1e9))
+    assert eng.early_stop_exits == 0
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    got2, _ = eng.predict(X, early_stop=(4, 1e-9))
+    assert eng.early_stop_exits >= 1
+    assert got2.shape == want.shape
+
+
+def test_early_stop_pred_leaf_disables_exit():
+    bst, X, _ = _train(iters=16)
+    eng = ForestEngine(bst.trees, mode="raw")
+    _, leaves = eng.predict(X, pred_leaf=True, early_stop=(2, 1e-9))
+    assert eng.early_stop_exits == 0              # leaf ids need every tree
+    want_leaves = predict_raw_values(bst.trees, X, leaf_index=True)
+    np.testing.assert_array_equal(leaves, want_leaves)

@@ -81,6 +81,12 @@ DIRECTION: Dict[str, int] = {
     "auc": +1,
     "auc_ours_1m_100it": +1,
     "ndcg10": +1,
+    "coldstart_cold_s": -1,          # fresh-process serve to first score
+    "coldstart_aot_s": -1,           # same, from the AOT artifact
+    "coldstart_speedup": +1,
+    "serve_hbm_per_model_mb_f32": -1,
+    "serve_hbm_per_model_mb_compact": -1,
+    "serve_model_density_x": +1,     # f32 bytes / compact bytes
 }
 # quality metrics: tiny moves are real; gate at 0.5%, not the timing 5%
 QUALITY = frozenset({"auc", "auc_ours_1m_100it", "ndcg10"})
@@ -101,6 +107,11 @@ METRIC_STAGE = {
     "auc_ours_1m_100it": "ref_parity",
     "sweep_models_per_s_m8": "sweep", "sweep_speedup_m8": "sweep",
     "sweep_models_per_s_m32": "sweep", "sweep_speedup_m32": "sweep",
+    "coldstart_cold_s": "coldstart", "coldstart_aot_s": "coldstart",
+    "coldstart_speedup": "coldstart",
+    "serve_hbm_per_model_mb_f32": "coldstart",
+    "serve_hbm_per_model_mb_compact": "coldstart",
+    "serve_model_density_x": "coldstart",
 }
 # keys never judged nor listed as informational scalars
 _SKIP_KEYS = frozenset({"metric", "unit", "stage_reached", "stages_done",

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Write an AOT serving artifact (serve/aot.py) for a saved model.
+
+Builds the same ForestEngine a serving host would build for the model
+(optionally under a compact dtype plan), exports its bucketed traversal
+programs with `jax.export`, and writes the artifact directory a fresh
+`task=serve` process attaches via `tpu_serve_aot_dir` — reaching first
+score with zero new jax traces.
+
+Usage:
+
+  python tools/serve_export.py --model model.txt --out aot_dir \\
+      [--buckets 256,512,1024] [--compact off|f16|int8]
+
+The bucket list should cover the shapes live traffic actually hits:
+the warm-up bucket (`tpu_serve_warm_rows`, default 256 -> bucket 256)
+and the request bucket (`tpu_serve_max_batch_rows` rounded up to a
+power of two). Buckets the artifact does not cover simply fall back to
+the engine's own jit — an incomplete artifact is slower, never wrong.
+
+Exit code 0 on a written manifest, 2 on a bad model/arguments.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export AOT serving artifacts for a model")
+    ap.add_argument("--model", required=True,
+                    help="model text file (task=train output_model)")
+    ap.add_argument("--out", required=True,
+                    help="artifact directory to write (created)")
+    ap.add_argument("--buckets", default="256,512",
+                    help="comma-separated row buckets to export "
+                         "(powers of two; default 256,512)")
+    ap.add_argument("--compact", default="off",
+                    choices=("off", "f16", "int8"),
+                    help="compact dtype plan the serving host will use "
+                         "(the artifact signature includes it; export "
+                         "with the SAME plan the host sets via "
+                         "tpu_serve_compact)")
+    args = ap.parse_args(argv)
+
+    try:
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    except ValueError:
+        print(f"bad --buckets {args.buckets!r}", file=sys.stderr)
+        return 2
+    if not buckets or any(b <= 0 for b in buckets):
+        print(f"bad --buckets {args.buckets!r}", file=sys.stderr)
+        return 2
+
+    from lightgbm_tpu.models.model_text import load_model_from_string
+    from lightgbm_tpu.serve import ForestEngine, aot
+
+    try:
+        with open(args.model) as fh:
+            loaded = load_model_from_string(fh.read())
+    except (OSError, ValueError) as exc:
+        print(f"cannot load model {args.model!r}: {exc}", file=sys.stderr)
+        return 2
+    trees = loaded["trees"]
+    if not trees:
+        print(f"model {args.model!r} has no trees", file=sys.stderr)
+        return 2
+    k = int(loaded.get("num_tree_per_iteration", 1))
+    nfeat = int(loaded.get("max_feature_idx", -1)) + 1
+    if nfeat <= 0:
+        nfeat = int(max(t.split_feature.max() if t.num_leaves > 1 else 0
+                        for t in trees)) + 1
+
+    engine = ForestEngine(trees, num_class=k, mode="raw",
+                          compact=args.compact)
+    manifest = aot.export_artifact(engine, args.out, buckets, nfeat)
+    print(json.dumps({
+        "out": args.out, "kind": manifest["kind"],
+        "buckets": sorted(int(b) for b in manifest["buckets"]),
+        "compact": args.compact, "trees": len(trees),
+        "num_class": k, "num_features": nfeat,
+        "device_bytes": engine.device_bytes(),
+        "f32_device_bytes": engine.f32_device_bytes(),
+    }, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
