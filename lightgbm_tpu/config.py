@@ -470,6 +470,29 @@ class Config:
     # train. The trained model is byte-equal to the in-memory path at
     # the same sampled boundaries (runtime-only: not part of the model)
     tpu_stream_chunk_rows: int = 0
+    # stream-to-shard ingest (io/stream.py + dist/runtime.py): when a
+    # streamed load (tpu_stream_chunk_rows > 0) feeds a data-parallel
+    # run, each chunk is binned ON ITS OWNER DEVICE and written straight
+    # into that device's shard slice — the [n, U] single-host binned
+    # matrix never exists and peak host memory stays O(chunk_rows)
+    # regardless of n. "auto" (default): shard the stream whenever the
+    # distributed runtime would activate (tree_learner=data|voting and
+    # a >1-wide mesh); "on": shard for data/voting even on a 1-wide
+    # mesh (the host matrix is re-gathered on demand if a host-side
+    # consumer needs it); "off": always assemble the host matrix and
+    # shard later, today's two-step path. The sample draw is the same
+    # canonical single-host draw either way, so the model stays
+    # byte-equal at every mesh width (runtime-only: not part of the
+    # model or the resume signature)
+    tpu_stream_shard: str = "auto"
+    # host->device staging depth of the streamed-ingest pipeline: with
+    # the default 2, a producer thread parses chunk k+1 while chunk k
+    # is being transferred/binned on device (two staging buffers +
+    # async dispatch), so ingest wall-time approaches max(parse, bin)
+    # instead of their sum. 0/1 disables the prefetch thread and runs
+    # parse-then-bin sequentially (the honest baseline the bench's
+    # overlap-efficiency number compares against; runtime-only)
+    tpu_stream_pipeline_depth: int = 2
     # quantized gradient/hessian histogram accumulation on the MXU hist
     # path: per-tree stochastic-rounded int8/int16 gradient quantization
     # with per-leaf histogram rescale back to f32 units. Halves (int16)

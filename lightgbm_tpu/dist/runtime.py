@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import Optional
 
 __all__ = ["active", "build_mesh", "make_learner", "num_shards",
-           "rescatter_scores"]
+           "rescatter_scores", "stream_shard_mesh"]
 
 _PARALLEL_MODES = ("data", "feature", "voting")
 
@@ -49,6 +49,27 @@ def build_mesh(cfg, axis_name: str = "data"):
     """1-D mesh over the first `num_shards(cfg)` devices."""
     from ..parallel import default_mesh
     return default_mesh(num_shards(cfg), axis_name)
+
+
+def stream_shard_mesh(cfg):
+    """Mesh for stream-to-shard ingest, or None when the streamed load
+    should assemble the host matrix (the legacy two-step path).
+
+    Sharding the stream only pays when the training run will consume
+    the row shards in place: ``tree_learner=data|voting`` (feature-
+    parallel replicates rows). ``tpu_stream_shard="auto"`` additionally
+    requires the mesh the dist runtime would build to be wider than one
+    device; ``"on"`` shards even a 1-wide mesh (the serial device
+    learner re-gathers the host matrix on demand); ``"off"`` never
+    shards."""
+    mode = str(getattr(cfg, "tpu_stream_shard", "auto")).lower()
+    if mode == "off":
+        return None
+    if cfg.tree_learner not in ("data", "voting"):
+        return None
+    if mode != "on" and not active(cfg):
+        return None
+    return build_mesh(cfg, "data")
 
 
 def make_learner(cfg, train_data):

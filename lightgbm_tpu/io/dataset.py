@@ -153,7 +153,10 @@ class Dataset:
         self._dev_bins = None  # HBM copy left behind by streaming ingest
         self.num_data: int = 0
         self.num_total_features: int = 0
-        self.bins: Optional[np.ndarray] = None
+        self._bins: Optional[np.ndarray] = None
+        # True when the host matrix was dropped after sharding (the
+        # device shards are authoritative); reading `.bins` re-gathers
+        self._bins_freed: bool = False
         self.mappers: List[BinMapper] = []
         self.used_feature_map: np.ndarray = np.zeros(0, dtype=np.int32)
         self.real_feature_idx: np.ndarray = np.zeros(0, dtype=np.int32)
@@ -168,9 +171,39 @@ class Dataset:
 
     # ------------------------------------------------------------------
     @property
+    def bins(self) -> Optional[np.ndarray]:
+        """Host binned matrix. After `shard()` / stream-to-shard ingest
+        the host copy is freed (the per-device shards are authoritative);
+        the first host-side read re-gathers it from the mesh — a
+        correctness fallback, not a hot path."""
+        if self._bins is None and self._bins_freed:
+            self._bins = self._regather_bins()
+            self._bins_freed = False
+        return self._bins
+
+    @bins.setter
+    def bins(self, value) -> None:
+        self._bins = value
+        self._bins_freed = False
+
+    def _regather_bins(self) -> np.ndarray:
+        cache = getattr(self, "_shard_cache", None)
+        if cache is None:
+            raise RuntimeError(
+                "binned matrix was freed but no shard cache exists to "
+                "re-gather it from")
+        full = np.asarray(cache["bins"])      # [nd*per_shard, U] gather
+        return np.ascontiguousarray(full[:self.num_data])
+
+    @property
     def num_features(self) -> int:
         """Number of used (non-trivial) features."""
-        return 0 if self.bins is None else self.bins.shape[1]
+        if self._bins is not None:
+            return self._bins.shape[1]
+        cache = getattr(self, "_shard_cache", None)
+        if cache is not None:
+            return int(cache["bins"].shape[1])
+        return 0
 
     def feature_num_bin(self, sub_feature: int) -> int:
         return self.mappers[self.real_feature_idx[sub_feature]].num_bin
@@ -407,8 +440,8 @@ class Dataset:
                            feature_names: Optional[List[str]] = None,
                            categorical_feature: Optional[Sequence[int]]
                            = None,
-                           reference: Optional["Dataset"] = None
-                           ) -> "Dataset":
+                           reference: Optional["Dataset"] = None,
+                           alloc_bins: bool = True) -> "Dataset":
         """Streaming creation, step 1 of 3 (the reference's push-rows
         flow: `LGBM_DatasetCreateFromSampledColumn` + `PushRows`,
         c_api.h:52-256): bin mappers are found from a row SAMPLE, the
@@ -465,7 +498,11 @@ class Dataset:
         used = self.real_feature_idx
         max_nb = max((self.mappers[j].num_bin for j in used), default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
-        self.bins = np.zeros((self.num_data, len(used)), dtype=dtype)
+        self._bins_dtype = dtype
+        if alloc_bins:
+            self.bins = np.zeros((self.num_data, len(used)), dtype=dtype)
+        # else: stream-to-shard ingest — rows go straight to their owner
+        # device's shard slice and the [n, U] host matrix never exists
         self._push_cfg = cfg
         self._push_ref = reference
         self._push_pos = 0
@@ -553,6 +590,50 @@ class Dataset:
                                                       np.float64)
         self._push_pos = pos + k
 
+    def push_meta_rows(self, k: int, label=None, weight=None,
+                       init_score=None) -> None:
+        """Streaming creation, step 2 (stream-to-shard variant): advance
+        the push cursor and record the chunk's metadata WITHOUT a host
+        bins write — the binned rows were appended directly into their
+        owner device's shard slice (io/stream.ShardedAppender), so there
+        is no host matrix to fill. Same ordering contract as
+        :meth:`push_binned_rows`."""
+        if getattr(self, "_push_pos", None) is None:
+            raise RuntimeError(
+                "push_meta_rows requires a dataset made by "
+                "create_from_sample")
+        k = int(k)
+        pos = self._push_pos
+        if pos + k > self.num_data:
+            raise ValueError(
+                f"push_meta_rows overflow: {pos + k} > "
+                f"n_total={self.num_data}")
+        if label is not None:
+            if self._push_label is None:
+                self._push_label = np.zeros(self.num_data, np.float64)
+            self._push_label[pos:pos + k] = np.asarray(label, np.float64)
+        if weight is not None:
+            if self._push_weight is None:
+                self._push_weight = np.ones(self.num_data, np.float64)
+            self._push_weight[pos:pos + k] = np.asarray(weight, np.float64)
+        if init_score is not None:
+            if self._push_init is None:
+                self._push_init = np.zeros(self.num_data, np.float64)
+            self._push_init[pos:pos + k] = np.asarray(init_score,
+                                                      np.float64)
+        self._push_pos = pos + k
+
+    def bins_dtype(self) -> Optional[np.dtype]:
+        """dtype of the binned matrix WITHOUT materializing a freed host
+        copy (gate checks on the distributed path must stay O(1))."""
+        if self._bins is not None:
+            return self._bins.dtype
+        cache = getattr(self, "_shard_cache", None)
+        if cache is not None:
+            return np.dtype(cache["bins"].dtype)
+        dt = getattr(self, "_bins_dtype", None)
+        return np.dtype(dt) if dt is not None else None
+
     def attach_device_bins(self, dev_bins) -> None:
         """Adopt an HBM-resident copy of ``bins`` built during streaming
         ingest (io/stream.py) so the serial learner's first upload is a
@@ -597,9 +678,12 @@ class Dataset:
         # closure reads live state, so the post-bundle shrink is what a
         # snapshot reports)
         from ..obs import memory as obs_memory
+        # the closure reads RAW storage (`_bins`), never the property: a
+        # freed-after-shard matrix must report 0 bytes, not silently
+        # re-gather the full host copy on every accountant snapshot
         obs_memory.track(
             "dataset/bins", self,
-            lambda d: 0 if d.bins is None else int(d.bins.nbytes))
+            lambda d: 0 if d._bins is None else int(d._bins.nbytes))
         from .bundling import apply_bundles, plan_bundles
         if reference is not None:
             # valid sets reuse the training set's bundling so binned
@@ -618,8 +702,8 @@ class Dataset:
         # partition / traversal kernels understand the bundled layout.
         renew = {"regression_l1", "l1", "mae", "huber", "fair", "quantile",
                  "mape", "poisson", "gamma", "tweedie"}
-        if (not getattr(cfg, "enable_bundle", True) or self.bins is None
-                or self.bins.dtype != np.uint8 or self.num_features < 3
+        if (not getattr(cfg, "enable_bundle", True) or self._bins is None
+                or self._bins.dtype != np.uint8 or self.num_features < 3
                 or cfg.tree_learner != "serial"
                 or str(cfg.boosting) not in ("gbdt", "goss")
                 or str(cfg.objective) in renew
@@ -686,21 +770,48 @@ class Dataset:
                  "nd": nd, "per_shard": per_shard, "pad_rows": pad_rows,
                  "bins": bins_sharded, "bins_T": bins_t}
         self._shard_cache = cache
-        # per-device HBM owners: each device holds per_shard rows of the
-        # binned matrix plus its slice of the transpose
+        self._register_shard_owners(cache)
+        # the placement is complete and authoritative: drop the host
+        # copy (it was doubling peak memory next to the device shards).
+        # A later host-side read re-gathers through the `bins` property.
+        self._bins = None
+        self._bins_freed = True
+        self._dev_bins = None
+        return cache
+
+    def _register_shard_owners(self, cache: Dict[str, Any]) -> None:
+        """Per-device HBM owners for a freshly placed shard cache (each
+        device holds per_shard rows of the binned matrix plus its slice
+        of the transpose), and the `dist_shard` announcement."""
+        nd = cache["nd"]
+        per_shard = cache["per_shard"]
+        dt = np.dtype(cache["bins"].dtype)
+        per_dev = 2 * per_shard * int(cache["bins"].shape[1]) * dt.itemsize
         from ..obs import memory as obs_memory
-        per_dev = 2 * per_shard * int(bins_np.shape[1]) * bins_np.itemsize
         for i in range(nd):
             obs_memory.track(
                 f"dist/shard_bytes/d{i}", self,
-                lambda d, nb=per_dev, k=key: (
+                lambda d, nb=per_dev, k=cache["key"]: (
                     nb if (getattr(d, "_shard_cache", None) is not None
                            and d._shard_cache["key"] == k) else 0))
         from ..utils import log
         log.event("dist_shard", shards=nd, rows_per_shard=per_shard,
-                  pad_rows=pad_rows, bytes_per_device=per_dev,
+                  pad_rows=cache["pad_rows"], bytes_per_device=per_dev,
                   bin_sync_ms=getattr(self, "_bin_sync_ms", None))
-        return cache
+
+    def attach_shard_cache(self, cache: Dict[str, Any]) -> None:
+        """Adopt a shard placement assembled by stream-to-shard ingest
+        (io/stream.ShardedAppender.finish): the cache dict has exactly
+        the shape `shard()` builds, so a later `shard(mesh)` call with
+        the same mesh is a cache hit and the learner reuses the buffers
+        the loader already filled. The host matrix never existed; the
+        `bins` property re-gathers on demand if a host-side consumer
+        asks."""
+        self._shard_cache = cache
+        self._register_shard_owners(cache)
+        self._bins = None
+        self._bins_freed = True
+        self._dev_bins = None
 
     def _native_bin_matrix(self, data: np.ndarray, used: np.ndarray,
                            dtype) -> Optional[np.ndarray]:

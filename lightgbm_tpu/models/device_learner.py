@@ -391,8 +391,7 @@ class DeviceTreeLearner:
                 and not self.cfg.sequential_device_only
                 and not self.bundled
                 and self.parallel_mode in ("serial", "data")
-                and self.ds.bins is not None
-                and self.ds.bins.dtype == np.uint8
+                and self.ds.bins_dtype() == np.uint8
                 and self.num_features > 0
                 and self.cfg.num_leaves >= 2)
 
@@ -1268,7 +1267,7 @@ class DeviceTreeLearner:
             return f"chunk count {nc} > 65535"
         if self.num_features > 1020:
             return f"num_features {self.num_features} > 1020"
-        if self.ds.bins is None or self.ds.bins.dtype != np.uint8:
+        if self.ds.bins_dtype() != np.uint8:
             return "bins not uint8"
         if self.num_features <= 0:
             return "no features"

@@ -174,6 +174,20 @@ class GBDT:
             # mesh degenerates to the serial device learner.
             from ..dist import runtime as dist_runtime
             if cfg.tree_learner == "serial" or not dist_runtime.active(cfg):
+                from ..utils import log
+                if (getattr(train_data, "_bins_freed", False)
+                        and getattr(train_data, "_bins", None) is None):
+                    # stream-to-shard built per-device shards but the run
+                    # degenerated to the serial learner (1-wide mesh or
+                    # tpu_stream_shard="on" without a parallel learner):
+                    # the first host-side bins read below re-gathers the
+                    # full matrix from the mesh. Correct, but the O(n)
+                    # host copy the sharded ingest avoided comes back.
+                    log.warning(
+                        "dataset was stream-sharded but the run routes to "
+                        "the serial device learner; re-gathering the host "
+                        "binned matrix (set tpu_stream_shard=off or widen "
+                        "the mesh to avoid the extra copy)")
                 self.learner = DeviceTreeLearner(cfg, train_data)
             else:
                 self.learner = dist_runtime.make_learner(cfg, train_data)

@@ -47,7 +47,8 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
     "tpu_dist_devices",
     # how the matrix was ingested does not change what it binned to
-    "tpu_stream_chunk_rows"})
+    "tpu_stream_chunk_rows", "tpu_stream_shard",
+    "tpu_stream_pipeline_depth"})
 
 
 def _feature_infos(mappers) -> List[str]:

@@ -84,6 +84,10 @@ EVENTS: Dict[str, str] = {
                    "score buffers back onto the mesh",
     "dist_shard": "dataset sharded across the mesh: rows per shard, "
                   "per-device HBM bytes, bin-sync wall time",
+    "dist_stream": "stream-to-shard ingest finished: rows, mesh width, "
+                   "chunk size, parse/bin walls + overlap efficiency of "
+                   "the double-buffered pipeline, per-device shard "
+                   "bytes and their HBM-accountant owner names",
     # resilience
     "checkpoint": "full-training-state checkpoint written (iter, path, "
                   "reason, write cost)",

@@ -57,6 +57,15 @@ TERMS: Dict[str, str] = {
     "ingest": "streaming out-of-core ingest wall time (sample pass + "
               "on-device chunk binning + HBM append) at dataset "
               "construction",
+    "ingest_parse": "host side of the pipelined stream-to-shard ingest: "
+                    "text parse + used-column select/transpose/pad on "
+                    "the prefetch thread (overlaps ingest_bin; the two "
+                    "sum to MORE than the ingest wall when the pipeline "
+                    "overlaps)",
+    "ingest_bin": "device side of the pipelined stream-to-shard ingest: "
+                  "chunk transfer + owner-device searchsorted binning + "
+                  "donated shard append, including the double-buffer "
+                  "pacing waits",
     "quant_pack": "stochastic-rounded gradient quantization pass of "
                   "the quantized-histogram path (per-tree int8/int16 "
                   "pack + scale)",

@@ -1,0 +1,255 @@
+"""Stream-to-shard ingest (io/stream.ShardedAppender + the pipelined
+loader): each parsed chunk is binned on its OWNER device and written
+straight into that device's shard slice — the `[n, U]` host matrix never
+exists. The contract under test:
+
+- the trained model is BYTE-equal to the in-memory serial twin at every
+  mesh width (1/2/4) under ``tpu_use_f64_hist``, for plain, bagging and
+  multiclass runs, at chunk sizes that do and do not divide the
+  per-device row block;
+- peak host memory stays O(chunk) (tracemalloc) and the HBM accountant
+  reports the shards on their per-device owners, not ``dataset/bins``;
+- the legacy path frees the host matrix after ``shard()`` and
+  re-gathers it bitwise on demand;
+- a killed streamed-sharded run resumes bitwise (the dist rescatter
+  path under a file-backed, stream-ingested dataset).
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.io.stream import stream_matrix
+from lightgbm_tpu.obs import memory as obs_memory
+from lightgbm_tpu.utils import log as lgb_log
+
+BASE = {"objective": "binary", "num_iterations": 6, "num_leaves": 15,
+        "min_data_in_leaf": 5, "max_bin": 63, "verbosity": -1,
+        "deterministic": True, "seed": 7, "tpu_use_f64_hist": True}
+
+
+def _problem(n=400, f=12, classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f))
+    X[:, 3] = rng.integers(0, 5, size=n)
+    if classes == 2:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    else:
+        y = rng.integers(0, classes, size=n).astype(np.float64)
+    return X, y
+
+
+def _ref_model(X, y, extra=None):
+    p = dict(BASE, **(extra or {}))
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p)) \
+        .model_to_string()
+
+
+def _sharded_model(X, y, width, chunk, extra=None, depth=None):
+    p = dict(BASE, tree_learner="data", tpu_dist_devices=width,
+             tpu_stream_chunk_rows=chunk, **(extra or {}))
+    if width == 1:
+        p["tpu_stream_shard"] = "on"   # a 1-wide mesh is auto-off
+    if depth is not None:
+        p["tpu_stream_pipeline_depth"] = depth
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds)
+    return bst.model_to_string(), ds._handle
+
+
+# ---------------------------------------------------------------------------
+# byte-equality across mesh widths and training variants
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("width", [1, 2, 4])
+def test_byte_equal_plain(width):
+    X, y = _problem()
+    ref = _ref_model(X, y)
+    got, h = _sharded_model(X, y, width, chunk=37)
+    assert got == ref
+    st = h._ingest_stats
+    assert st["sharded"] and st["shards"] == width
+    assert st["rows"] == 400
+
+
+@pytest.mark.parametrize("width", [2, 4])
+def test_byte_equal_bagging(width):
+    extra = {"bagging_fraction": 0.7, "bagging_freq": 1,
+             "bagging_seed": 3, "feature_fraction": 0.8}
+    X, y = _problem(seed=1)
+    ref = _ref_model(X, y, extra)
+    got, _ = _sharded_model(X, y, width, chunk=64, extra=extra)
+    assert got == ref
+
+
+@pytest.mark.parametrize("width", [2, 4])
+def test_byte_equal_multiclass(width):
+    extra = {"objective": "multiclass", "num_class": 3, "metric": "none"}
+    X, y = _problem(classes=3, seed=2)
+    ref = _ref_model(X, y, extra)
+    got, _ = _sharded_model(X, y, width, chunk=90, extra=extra)
+    assert got == ref
+
+
+@pytest.mark.parametrize("chunk", [50, 37, 150, 400])
+def test_chunk_boundary_cases(chunk):
+    """n=400 on a 4-wide mesh puts 100 rows on each device: chunk=50
+    divides the block, 37 does not (appends straddle shard-local
+    offsets), 150 spans devices inside one chunk, 400 is single-chunk.
+    All must be byte-equal to the serial twin."""
+    X, y = _problem(seed=3)
+    ref = _ref_model(X, y)
+    got, h = _sharded_model(X, y, 4, chunk=chunk)
+    assert got == ref
+    assert h._ingest_stats["chunk_rows"] == chunk
+
+
+def test_pipeline_depth_off_is_byte_equal():
+    """depth<=1 runs the honest sequential parse-then-bin baseline —
+    same bytes, no prefetch thread."""
+    X, y = _problem(seed=4)
+    ref = _ref_model(X, y)
+    got, h = _sharded_model(X, y, 4, chunk=64, depth=1)
+    assert got == ref
+    assert h._ingest_stats["pipeline_depth"] == 1
+
+
+def test_streamed_file_sharded_byte_equal(tmp_path):
+    """The file loader's stream-to-shard branch: same bytes as the
+    in-memory serial model trained from the SAME file."""
+    X, y = _problem(n=500, seed=5)
+    path = str(tmp_path / "train.tsv")
+    with open(path, "w") as fh:
+        for i in range(len(y)):
+            fh.write("\t".join([f"{y[i]:g}"]
+                               + [f"{v:.6g}" for v in X[i]]) + "\n")
+    p_ref = dict(BASE)
+    ref = lgb.train(p_ref, lgb.Dataset(path, params=p_ref))
+    p_s = dict(BASE, tree_learner="data", tpu_dist_devices=4,
+               tpu_stream_chunk_rows=120)
+    ds = lgb.Dataset(path, params=p_s)
+    bst = lgb.train(p_s, ds)
+    assert bst.model_to_string() == ref.model_to_string()
+    st = ds._handle._ingest_stats
+    assert st["sharded"] and st["shards"] == 4
+    assert st["shard_bytes"] > 0 and "total_ms" in st
+
+
+# ---------------------------------------------------------------------------
+# memory model: no full host matrix, owners on the devices
+# ---------------------------------------------------------------------------
+
+def test_sharded_ingest_never_materializes_host_matrix():
+    """Matrix 8x the chunk size through stream-to-shard: tracemalloc
+    peak stays under one full f64 copy (tracemalloc sees numpy buffers;
+    the [n, U] host matrix would show up), the dataset's host bins stay
+    freed, and the HBM accountant attributes the bytes to the per-device
+    shard owners — not ``dataset/bins``."""
+    import tracemalloc
+
+    X, y = _problem(n=8000, f=16, seed=6)
+    cfg = Config.from_params(dict(BASE, tree_learner="data",
+                                  tpu_dist_devices=4,
+                                  tpu_stream_chunk_rows=1000,
+                                  bin_construct_sample_cnt=1000))
+    full_f64 = X.shape[0] * X.shape[1] * 8
+    # warm the jit caches so compile scratch doesn't pollute the peak
+    stream_matrix(X[:2000], label=y[:2000], config=cfg)
+    obs_memory.reset()   # drop other tests' live owners from the ledger
+    tracemalloc.start()
+    ds = stream_matrix(X, label=y, config=cfg)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < full_f64, (peak, full_f64)
+    assert ds._bins is None and ds._bins_freed
+    owners = obs_memory.owners_bytes()
+    assert owners["dataset/bins"]["bytes"] == 0
+    dist_bytes = [v["bytes"] for k, v in owners.items()
+                  if k.startswith("dist/shard_bytes/")]
+    assert len(dist_bytes) == 4 and all(b > 0 for b in dist_bytes)
+    # re-gather on demand matches the in-memory binned matrix bitwise
+    one = CoreDataset.from_matrix(X, label=y, config=cfg)
+    np.testing.assert_array_equal(ds.bins, one.bins)
+
+
+def test_legacy_shard_frees_host_matrix():
+    """Satellite regression: the legacy in-memory path also drops the
+    host matrix once `shard()` has placed the device shards, and the
+    first host-side read re-gathers it bitwise."""
+    from lightgbm_tpu.parallel import default_mesh
+
+    X, y = _problem(n=320, seed=7)
+    cfg = Config.from_params(dict(BASE))
+    obs_memory.reset()   # drop other tests' live owners from the ledger
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    before = np.array(ds.bins, copy=True)
+    ds.shard(default_mesh(4, "data"), "data")
+    assert ds._bins is None and ds._bins_freed
+    owners = obs_memory.owners_bytes()
+    assert owners["dataset/bins"]["bytes"] == 0
+    per_dev = owners["dist/shard_bytes/d0"]["bytes"]
+    assert per_dev == 2 * 80 * before.shape[1] * before.dtype.itemsize
+    np.testing.assert_array_equal(ds.bins, before)   # re-gather
+    assert not ds._bins_freed
+
+
+def test_dist_stream_event_emitted():
+    lines = []
+    lgb_log.register_callback(lines.append)
+    # construct-time events fire before train() applies the params'
+    # verbosity, so undo any stale verbosity=-1 from earlier tests
+    lgb_log.set_verbosity(2)
+    try:
+        X, y = _problem(seed=8)
+        p = dict(BASE, tree_learner="data", tpu_dist_devices=4,
+                 tpu_stream_chunk_rows=64, verbosity=2)
+        ds = lgb.Dataset(X, label=y, params=p)
+        lgb.train(p, ds)
+    finally:
+        lgb_log.register_callback(None)
+    events = [e for e in (lgb_log.parse_event(ln) for ln in lines) if e]
+    ev = next(e for e in events if e["event"] == "dist_stream")
+    assert ev["shards"] == 4 and ev["rows"] == 400
+    assert ev["per_shard"] == 100
+    assert "dist/shard_bytes/d3" in ev["owners"]
+    assert float(ev["overlap_eff"]) > 0
+    kinds = {e["event"] for e in events}
+    assert "dist_shard" in kinds     # attach_shard_cache announces it
+    assert "stream_ingest" in kinds
+
+
+# ---------------------------------------------------------------------------
+# resume-after-kill on a streamed-sharded run
+# ---------------------------------------------------------------------------
+
+def test_resume_bitwise_streamed_sharded(tmp_path):
+    """kill@R / resume parity for a file-backed stream-to-shard run:
+    restore gathers the score buffers, the dist runtime rescatters them
+    onto the mesh, and the resumed model serializes to the
+    uninterrupted run's bytes."""
+    X, y = _problem(n=480, seed=9)
+    path = str(tmp_path / "train.tsv")
+    with open(path, "w") as fh:
+        for i in range(len(y)):
+            fh.write("\t".join([f"{y[i]:g}"]
+                               + [f"{v:.6g}" for v in X[i]]) + "\n")
+    params = dict(BASE, tree_learner="data", tpu_dist_devices=4,
+                  tpu_stream_chunk_rows=100, num_iterations=14,
+                  bagging_fraction=0.7, bagging_freq=1, bagging_seed=3)
+
+    ref = lgb.train(dict(params), lgb.Dataset(path, params=params))
+
+    ckdir = str(tmp_path / "ck")
+    pk = dict(params, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=5,
+              tpu_fault_spec="kill@9")
+    part = lgb.train(pk, lgb.Dataset(path, params=pk))
+    assert part._preempted
+
+    pr = dict(params, tpu_checkpoint_dir=ckdir, tpu_checkpoint_freq=5)
+    res = lgb.train(pr, lgb.Dataset(path, params=pr))
+    assert not res._preempted
+    assert res._resilience["resumed_from"] == 10
+    assert res.model_to_string() == ref.model_to_string()

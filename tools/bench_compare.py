@@ -87,6 +87,8 @@ DIRECTION: Dict[str, int] = {
     "serve_hbm_per_model_mb_f32": -1,
     "serve_hbm_per_model_mb_compact": -1,
     "serve_model_density_x": +1,     # f32 bytes / compact bytes
+    "mc_ingest_s": -1,               # stream-to-shard ingest wall
+    "mc_ingest_overlap": +1,         # (parse+bin)/wall of the pipeline
 }
 # quality metrics: tiny moves are real; gate at 0.5%, not the timing 5%
 QUALITY = frozenset({"auc", "auc_ours_1m_100it", "ndcg10"})
@@ -112,6 +114,7 @@ METRIC_STAGE = {
     "serve_hbm_per_model_mb_f32": "coldstart",
     "serve_hbm_per_model_mb_compact": "coldstart",
     "serve_model_density_x": "coldstart",
+    "mc_ingest_s": "multichip", "mc_ingest_overlap": "multichip",
 }
 # keys never judged nor listed as informational scalars
 _SKIP_KEYS = frozenset({"metric", "unit", "stage_reached", "stages_done",

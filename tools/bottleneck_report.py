@@ -159,14 +159,32 @@ def stage_rows(bench):
         bench = bench.get("parsed")
     stages = (bench or {}).get("terms_by_stage") or {}
     out = {}
+    pipelines = {}
     for stage, terms in stages.items():
+        # pipelined stream-to-shard ingest: parse and bin legs OVERLAP,
+        # so they must not enter the flat ranking next to the ingest
+        # wall (they'd double-count it) — they become their own
+        # pipeline row with the overlap efficiency and the bound side
+        terms = dict(terms)
+        parse = terms.pop("ingest_parse", None)
+        binleg = terms.pop("ingest_bin", None)
+        ingest = terms.get("ingest")
+        if parse is not None and binleg is not None and ingest:
+            seq = parse + binleg
+            pipelines[stage] = {
+                "ingest_ms": round(ingest, 1),
+                "parse_ms": round(parse, 1),
+                "bin_ms": round(binleg, 1),
+                "overlap_eff": round(seq / ingest, 3),
+                "bound": "parse" if parse >= binleg else "bin",
+            }
         total = sum(v for v in terms.values() if v) or 1.0
         out[stage] = [{"term": t, "ms": round(v, 3),
                        "share": round(v / total, 4)}
                       for t, v in sorted(terms.items(),
                                          key=lambda kv: -(kv[1] or 0))
                       if v is not None]
-    return out
+    return out, pipelines
 
 
 def build_report(args):
@@ -200,7 +218,9 @@ def build_report(args):
             report["captures"] = prof["captures"]
     bench = _load_json(args.bench, "bench record")
     if bench:
-        report["terms_by_stage"] = stage_rows(bench)
+        report["terms_by_stage"], pipelines = stage_rows(bench)
+        if pipelines:
+            report["ingest_pipeline"] = pipelines
     return report
 
 
@@ -224,11 +244,19 @@ def print_report(report, top):
             p(f"     build/{t:<12} {ms:>10.2f} ms  "
               f"{decomp['shares'].get(t, 0) * 100:5.1f}%")
     stages = report.get("terms_by_stage") or {}
+    pipelines = report.get("ingest_pipeline") or {}
     for stage, rows in stages.items():
         p(f"\nbench stage {stage!r} terms:")
         for r in rows[:top]:
             p(f"     {r['term']:<14} {r['ms']:>10.2f} ms  "
               f"{r['share'] * 100:5.1f}%")
+        pl = pipelines.get(stage)
+        if pl:
+            p(f"     ingest pipeline: parse={pl['parse_ms']} ms / "
+              f"bin={pl['bin_ms']} ms overlapped into "
+              f"{pl['ingest_ms']} ms  "
+              f"(overlap_eff={pl['overlap_eff']}x, "
+              f"{pl['bound']}-bound)")
     progs = report.get("programs") or []
     if progs:
         dev = report.get("device") or {}
