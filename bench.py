@@ -936,6 +936,85 @@ def run_sweep(X, y, leaves, iters, M):
             f"sweep_byte_equal_m{M}": bool(equal)}
 
 
+def run_sweep_variant(X, y, leaves, iters, M, variant):
+    """Boosting-variant fleet throughput (GOSS or DART): the batched
+    vmapped round program vs the interleaved round-robin fallback those
+    fleets used before the variant gate opened. Same fleet, same
+    Dataset, byte-equal asserted between the two modes (both are
+    byte-equal to sequential by the tier-1 parity tests; here the
+    cheaper interleaved arm doubles as the oracle)."""
+    from lightgbm_tpu.sweep import train_many
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "min_data_in_leaf": 20, "tpu_use_f64_hist": True,
+              "verbosity": -1, "boosting": variant}
+    if variant == "goss":
+        params.update(top_rate=0.2, other_rate=0.1)
+    else:
+        params.update(drop_rate=0.3, skip_drop=0.5)
+    # rates past the GOSS warm-up ramp so the select program runs
+    lrs = np.linspace(0.25, 0.6, M)
+    grids = [dict(params, learning_rate=round(float(lr), 4))
+             for lr in lrs]
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+
+    train_many([dict(p) for p in grids], ds, num_boost_round=1)
+    t0 = time.perf_counter()
+    fleet = train_many([dict(p) for p in grids], ds,
+                       num_boost_round=iters)
+    bat_s = time.perf_counter() - t0
+
+    inter_grids = [dict(p, tpu_sweep_mode="interleaved") for p in grids]
+    train_many([dict(p) for p in inter_grids], ds, num_boost_round=1)
+    t0 = time.perf_counter()
+    inter = train_many(inter_grids, ds, num_boost_round=iters)
+    inter_s = time.perf_counter() - t0
+
+    equal = all(a.model_to_string() == b.model_to_string()
+                for a, b in zip(fleet, inter))
+    models_per_s = round(M / max(bat_s, 1e-9), 3)
+    inter_per_s = round(M / max(inter_s, 1e-9), 3)
+    speedup = round(inter_s / max(bat_s, 1e-9), 2)
+    log(f"# sweep {variant} m={M}: batched {bat_s:.2f}s vs interleaved "
+        f"{inter_s:.2f}s -> {speedup}x, {models_per_s} vs {inter_per_s} "
+        f"models/s, byte_equal={equal}")
+    return {f"sweep_models_per_s_{variant}_m{M}": models_per_s,
+            f"sweep_models_per_s_{variant}_interleaved_m{M}": inter_per_s,
+            f"sweep_speedup_{variant}_m{M}": speedup,
+            f"sweep_byte_equal_{variant}_m{M}": bool(equal)}
+
+
+def run_sweep_hetero(X, y, iters, M):
+    """Heterogeneous M-in-the-hundreds fleet: mixed num_leaves configs
+    partitioned into shape-bucketed sub-fleets (sweep/subfleet.py), each
+    its own batched program, interleaved dispatch. Reports fleet
+    throughput and the sub-fleet count actually planned — the leg the
+    uniform-shape gate used to force through M sequential-ish rounds."""
+    from lightgbm_tpu.sweep import plan_subfleets, train_many
+    params = {"objective": "binary", "max_bin": 63, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "tpu_use_f64_hist": True,
+              "verbosity": -1}
+    shapes = (15, 31, 63)
+    grids = [dict(params, num_leaves=shapes[m % len(shapes)],
+                  learning_rate=round(0.05 + 0.25 * m / M, 4))
+             for m in range(M)]
+    ds = lgb.Dataset(X, label=y, params=params).construct()
+
+    probes = [lgb.Booster(params=dict(p), train_set=ds) for p in grids]
+    plans = plan_subfleets([b._gbdt for b in probes],
+                           [b._cfg for b in probes])
+    del probes
+
+    train_many([dict(p) for p in grids], ds, num_boost_round=1)
+    t0 = time.perf_counter()
+    train_many([dict(p) for p in grids], ds, num_boost_round=iters)
+    bat_s = time.perf_counter() - t0
+    models_per_s = round(M / max(bat_s, 1e-9), 3)
+    log(f"# sweep hetero m={M}: {bat_s:.2f}s across {len(plans)} "
+        f"sub-fleets -> {models_per_s} models/s")
+    return {f"sweep_models_per_s_hetero_m{M}": models_per_s,
+            f"sweep_subfleets_m{M}": len(plans)}
+
+
 def run_warm_rerun(out):
     """Spawn the fresh-process warm rerun and record cold vs warm."""
     import subprocess
@@ -1306,6 +1385,26 @@ def main() -> None:
             else:
                 out.update(run_sweep(X[:sw_rows], y[:sw_rows], leaves,
                                      sw_iters, 32))
+            # variant fleets: batched vs the interleaved fallback they
+            # used before the gate admitted them. The ratio is a
+            # device property — the batched program wins where the
+            # histogram build is an MXU one-hot contraction; on CPU
+            # emulation the vmapped scatter thrashes past a few
+            # thousand rows (the plain M=8 leg above degrades the same
+            # way), so smoke keeps the variant legs at a row count the
+            # emulated build handles in seconds
+            var_m = 4 if smoke else 8
+            var_rows = min(sw_rows, 2_000 if smoke else sw_rows)
+            for variant in ("goss", "dart"):
+                out.update(run_sweep_variant(
+                    X[:var_rows], y[:var_rows], leaves, sw_iters, var_m,
+                    variant))
+            # M=128 mixed-shape fleet via shape-bucketed sub-fleets;
+            # smoke keeps the fleet small but still multi-bucket
+            het_m, het_iters = (12, 5) if smoke else (128, 10)
+            het_rows = min(sw_rows, 2_000 if smoke else 20_000)
+            out.update(run_sweep_hetero(X[:het_rows], y[:het_rows],
+                                        het_iters, het_m))
         except Exception as e:   # the summary line must still print
             log(f"# sweep stage FAILED: {type(e).__name__}: {e}")
         _stage_done("sweep", out)

@@ -646,9 +646,15 @@ import os
 
 d = os.environ["LGBT_BC_DIR"]
 base = {"metric": "higgs_synth_500iter_s", "unit": "s", "value": 300.0,
-        "sweep_models_per_s_m8": 4.0, "sweep_speedup_m8": 5.0}
+        "sweep_models_per_s_m8": 4.0, "sweep_speedup_m8": 5.0,
+        "sweep_models_per_s_goss_m8": 3.0,
+        "sweep_models_per_s_dart_m8": 2.0,
+        "sweep_models_per_s_hetero_m128": 6.0}
 json.dump(base, open(os.path.join(d, "sa.json"), "w"))
-json.dump(dict(base, sweep_models_per_s_m8=2.0, sweep_speedup_m8=2.5),
+json.dump(dict(base, sweep_models_per_s_m8=2.0, sweep_speedup_m8=2.5,
+               sweep_models_per_s_goss_m8=1.5,
+               sweep_models_per_s_dart_m8=1.0,
+               sweep_models_per_s_hetero_m128=3.0),
           open(os.path.join(d, "sb.json"), "w"))
 EOF
 set +e
@@ -772,6 +778,48 @@ by_model = {m: sorted(r["round"] for r in rounds if r.get("model") == m)
 assert all(v == list(range(ROUNDS)) for v in by_model.values()), by_model
 print(f"sweep smoke: ok (4 models byte-equal over {ROUNDS} rounds, "
       f"{len(rounds)} per-model ledger rounds, 1 sweep_init note)")
+EOF
+echo "== sweep variant smoke (GOSS + DART M=4, byte-equal vs sequential twins) =="
+SWEEP_VAR_DIR="$SWEEP_DIR/variants"
+mkdir -p "$SWEEP_VAR_DIR"
+SWEEP_SMOKE_DIR="$SWEEP_VAR_DIR" python - <<'EOF'
+import filecmp
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sweep import train_many
+
+out = os.environ["SWEEP_SMOKE_DIR"]
+rng = np.random.RandomState(5)
+X = rng.rand(300, 8).astype(np.float32)
+y = (X[:, 0] + X[:, 4] * 0.5 + rng.rand(300) * 0.1).astype(np.float32)
+base = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+        "tpu_use_f64_hist": True, "tpu_grow_mode": "leafwise",
+        "verbosity": -1}
+ROUNDS = 5
+variants = {
+    # rates past the 1/lr warm-up ramp so the GOSS select program runs
+    "goss": dict(base, boosting="goss", top_rate=0.3, other_rate=0.2),
+    "dart": dict(base, boosting="dart", drop_rate=0.5, skip_drop=0.3),
+}
+for variant, vbase in variants.items():
+    grids = [dict(vbase, learning_rate=lr)
+             for lr in (0.5, 0.3, 0.25, 0.4)]
+    fleet = train_many([dict(p, tpu_sweep_mode="batched") for p in grids],
+                       lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    for m, (bst, params) in enumerate(zip(fleet, grids)):
+        seq = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=ROUNDS)
+        a = os.path.join(out, f"{variant}_fleet_{m}.txt")
+        b = os.path.join(out, f"{variant}_seq_{m}.txt")
+        bst.save_model(a)
+        seq.save_model(b)
+        assert filecmp.cmp(a, b, shallow=False), \
+            f"{variant} model {m} diverged"
+    print(f"sweep {variant} smoke: ok (4 models byte-equal over "
+          f"{ROUNDS} rounds, batched mode forced)")
 EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
     echo "sweep artifacts kept under $SWEEP_DIR for artifact upload"

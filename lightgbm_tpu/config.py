@@ -710,14 +710,16 @@ class Config:
     # jax.profiler trace; artifact directory paths land in
     # trace_summary.json. Empty disables capture
     tpu_profile_capture: str = ""
-    # many-model sweep trainer (sweep/train_many): "auto" batches the
-    # whole fleet into one vmapped round program when every member
-    # shares shapes outside the sweep grid (learning_rate, lambda_l1/l2,
-    # bagging seed+freq, feature_fraction_seed may vary), falling back
-    # to an interleaved round-robin of per-model rounds otherwise;
-    # "batched" raises instead of falling back; "interleaved" forces the
-    # fallback. Runtime-only: excluded from model text and checkpoint
-    # signatures — model bytes are identical across modes
+    # many-model sweep trainer (sweep/train_many): "auto" partitions
+    # the fleet into shape-bucketed sub-fleets (sweep/subfleet.py) and
+    # batches each into one vmapped round program — GBDT, GOSS, and
+    # DART fleets, quantized histograms included, with the sweep grid
+    # (learning_rate, lambda_l1/l2, bagging seed+freq,
+    # feature_fraction_seed) as traced operands — falling back to an
+    # interleaved round-robin of per-model rounds for anything the gate
+    # rejects; "batched" raises instead of falling back; "interleaved"
+    # forces the fallback. Runtime-only: excluded from model text and
+    # checkpoint signatures — model bytes are identical across modes
     tpu_sweep_mode: str = "auto"
     # fleet checkpoint directory for train_many (MANIFEST.json + per-
     # model texts + score planes + host RNG). Empty disables fleet
@@ -726,6 +728,15 @@ class Config:
     # write a fleet checkpoint every N sweep rounds (0 = never).
     # Runtime-only, like tpu_checkpoint_freq
     tpu_sweep_checkpoint_freq: int = 0
+    # HBM budget in MiB for one batched sub-fleet's score stack (0 =
+    # ask the obs/memory accountant for device headroom, unbounded when
+    # the runtime has no memory_stats — e.g. CPU emulation). Fleets
+    # whose [M, K, N] stack would exceed it split into pow2-sized
+    # sub-fleets. Runtime-only, like tpu_sweep_mode
+    tpu_sweep_hbm_budget_mb: int = 0
+    # hard cap on models per batched sub-fleet (0 = uncapped); applied
+    # after the HBM budget. Runtime-only, like tpu_sweep_mode
+    tpu_sweep_max_fleet: int = 0
 
     # internal (set by trainer, reference config.h:832-833)
     is_parallel: bool = False

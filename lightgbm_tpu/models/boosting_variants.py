@@ -18,6 +18,31 @@ from .gbdt import GBDT, K_EPSILON, _ScoreUpdater
 from .tree import Tree
 
 
+def goss_select_body(g, h, seed, n: int, top_k: int, other_k: int):
+    """The raw device GOSS selection (goss.hpp:96-134) — single source
+    of truth for the sequential per-model program AND the sweep
+    trainer's vmapped fleet select (sweep/batched.py), so their bitwise
+    parity is by construction. |g*h| summed over classes, threshold at
+    the top_k'th value, the rest sampled without replacement as the
+    other_k smallest uniform keys under ``PRNGKey(seed)`` (row-index
+    tie-broken via a stable argsort rank — f32 keys collide ~every
+    other iteration at 10M rows). Returns the [N] keep-mask and the
+    [N] small-gradient re-weight multiplier."""
+    multiply = (n - top_k) / other_k
+    a = jnp.abs(g * h).sum(axis=0)
+    s = jnp.sort(a)
+    threshold = s[n - top_k]
+    big = a >= threshold
+    u = jax.random.uniform(jax.random.PRNGKey(seed), (n,))
+    order = jnp.argsort(jnp.where(big, 2.0, u), stable=True)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    sampled = (~big) & (rank < other_k)
+    mask = big | sampled
+    mult = jnp.where(sampled, jnp.float32(multiply), 1.0)
+    return mask, mult
+
+
 class GOSS(GBDT):
     """Gradient-based one-side sampling (goss.hpp:25-160): keep the
     top_rate fraction by |g*h|, sample other_rate of the rest and up-weight
@@ -52,28 +77,9 @@ class GOSS(GBDT):
         seed = int(self._bag_rng.randint(0, 2**31 - 1))
         fn = self._goss_select_fn
         if fn is None:
-            multiply = (n - top_k) / other_k
-
             def select(g, h, seed_arr):
-                # |g*h| summed over classes (goss.hpp:96-101)
-                a = jnp.abs(g * h).sum(axis=0)
-                s = jnp.sort(a)
-                threshold = s[n - top_k]
-                big = a >= threshold
-                # without-replacement sample of the rest: the other_k
-                # smallest uniform keys among non-big rows, row-index
-                # tie-broken (f32 keys collide ~every other iteration
-                # at 10M rows) so exactly other_k are taken
-                u = jax.random.uniform(jax.random.PRNGKey(seed_arr[0]),
-                                       (n,))
-                # order keys as (u, row) pairs via a stable argsort rank
-                order = jnp.argsort(jnp.where(big, 2.0, u), stable=True)
-                rank = jnp.zeros(n, jnp.int32).at[order].set(
-                    jnp.arange(n, dtype=jnp.int32))
-                sampled = (~big) & (rank < other_k)
-                mask = big | sampled
-                mult = jnp.where(sampled, jnp.float32(multiply), 1.0)
-                return mask, mult
+                return goss_select_body(g, h, seed_arr[0], n, top_k,
+                                        other_k)
             fn = jax.jit(select)
             self._goss_select_fn = fn
         mask_dev, mult_dev = fn(self._cur_grad, self._cur_hess,

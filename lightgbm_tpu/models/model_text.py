@@ -43,7 +43,8 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     # sweep-trainer infrastructure: the fleet's model bytes must match
     # the sequential twin's regardless of how the sweep was driven
     "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
-    "tpu_sweep_checkpoint_freq",
+    "tpu_sweep_checkpoint_freq", "tpu_sweep_hbm_budget_mb",
+    "tpu_sweep_max_fleet",
     "tree_learner", "num_machines", "is_parallel", "is_parallel_find_bin",
     "tpu_dist_devices",
     # how the matrix was ingested does not change what it binned to

@@ -75,6 +75,12 @@ EVENTS: Dict[str, str] = {
                   "reason when batching was rejected",
     "sweep_refresh": "continual-refresh cycle published the retrained "
                      "fleet's serving checkpoint versions",
+    "sweep_refresh_triggered": "a serving model's SLO burn rate crossed "
+                               "the trigger threshold; it is enqueued "
+                               "for the next refresh fleet",
+    "sweep_subfleet": "one shape-bucketed batched sub-fleet started: "
+                      "member indices, size, split reason (shape / hbm "
+                      "/ cap), score-stack MiB, variant",
     "sweep_train": "train_many finished: fleet size, mode, rounds, "
                    "wall time, trace count",
     # distributed runtime (dist/)

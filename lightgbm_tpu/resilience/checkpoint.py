@@ -63,7 +63,8 @@ RUNTIME_ONLY_PARAMS = frozenset({
     # resumed with different sweep plumbing, and a sequential checkpoint
     # is mode-independent anyway
     "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
-    "tpu_sweep_checkpoint_freq",
+    "tpu_sweep_checkpoint_freq", "tpu_sweep_hbm_budget_mb",
+    "tpu_sweep_max_fleet",
     # topology: trees are bit-identical across tree_learner / shard-count
     # choices (distributed parity contract), so a checkpoint taken on one
     # topology may resume on another — e.g. a preempted 4-chip run

@@ -17,8 +17,12 @@ checkpoints the existing serving watcher hot-swaps live.
 See docs/Sweep.md for the batching model and the parity contract.
 """
 from .batched import SWEEP_VARYING, batched_gate, shared_grid_signature
-from .refresh import refresh_many, write_serving_checkpoint
+from .refresh import (RefreshTrigger, refresh_due, refresh_many,
+                      write_serving_checkpoint)
+from .subfleet import SubfleetPlan, plan_subfleets
 from .trainer import train_many
 
 __all__ = ["train_many", "refresh_many", "write_serving_checkpoint",
-           "batched_gate", "shared_grid_signature", "SWEEP_VARYING"]
+           "batched_gate", "shared_grid_signature", "SWEEP_VARYING",
+           "plan_subfleets", "SubfleetPlan", "RefreshTrigger",
+           "refresh_due"]

@@ -13,6 +13,18 @@ which XLA rejects as mixed precision — the raw bodies keep the
 the exact expression tree the sequential programs trace, and model text
 stays byte-equal per booster under ``tpu_use_f64_hist``.
 
+Boosting variants ride the same program. GOSS adds a per-model
+``[M, N]`` gradient multiplier operand plus a warm-up flag that selects
+between the partition-fill score lane (warm rounds train on the full
+data like fresh trees) and the traversal lane (sampled rounds) — the
+top-k selection itself is a separate small registered program
+(``make_goss_select_program``). DART needs NO program change at all:
+its drop/renormalize machinery is host-double leaf mutation, so the
+trainer reuses the sequential methods verbatim per model and only the
+per-round shrinkage operand moves. Quantized histograms thread the host
+``qseq`` counter as a traced per-model ``[M]`` round counter so
+``ops/histogram.quantize_gh`` composes with the vmapped round.
+
 Registry discipline: the program enters the process-wide compile cache
 keyed by the learner/objective trace signatures with the swept fields
 normalized out, so model #2..M cost zero traces by construction (one
@@ -41,9 +53,13 @@ from ..ops.sweep_ops import (partition_score_update_lane,
 #   bagging_seed/bagging_freq-> host RNG schedule; bag partitions are
 #                               per-model index operands
 #   feature_fraction_seed    -> host RNG; masks are per-model operands
+#   drop_seed/drop_rate/     -> DART's drop plan is drawn on HOST per
+#     skip_drop                 model (never traced); only the resulting
+#                               per-round shrinkage is an operand
 SWEEP_VARYING = frozenset({
     "learning_rate", "lambda_l1", "lambda_l2",
     "bagging_seed", "bagging_freq", "feature_fraction_seed",
+    "drop_seed", "drop_rate", "skip_drop",
 })
 
 # The sweep trainer's own knobs: runtime infrastructure, never part of
@@ -51,7 +67,8 @@ SWEEP_VARYING = frozenset({
 # signatures — see models/model_text.py, resilience/checkpoint.py).
 SWEEP_RUNTIME = frozenset({
     "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",
-    "tpu_sweep_checkpoint_freq",
+    "tpu_sweep_checkpoint_freq", "tpu_sweep_hbm_budget_mb",
+    "tpu_sweep_max_fleet",
 })
 
 _NORM = "<swept>"
@@ -66,8 +83,10 @@ def _normalized_config_items(cfg) -> Tuple:
 
 
 def shared_grid_signature(cfg) -> Tuple:
-    """The config signature every fleet member must share for batched
-    mode (grid fields and sweep-runtime knobs normalized out)."""
+    """The config signature every member of one batched SUB-FLEET must
+    share (grid fields and sweep-runtime knobs normalized out). Mixed
+    signatures across the whole fleet are fine — the trainer buckets
+    them into sub-fleets (sweep/subfleet.py)."""
     return _normalized_config_items(cfg)
 
 
@@ -81,76 +100,118 @@ def _normalized_learner_sig(learner) -> Tuple:
                  for item in learner.trace_signature())
 
 
+def sweep_variant(gbdt) -> str:
+    """The batched-round flavor of one booster: ``"gbdt"`` (plain and
+    DART — DART's round program IS the plain one, the drop machinery is
+    host-side) or ``"goss"`` (extra multiplier/warm-up operands)."""
+    from ..models.boosting_variants import GOSS
+    return "goss" if type(gbdt) is GOSS else "gbdt"
+
+
 def batched_gate(gbdts, cfgs) -> Optional[str]:
-    """None when the fleet can train in batched mode; else the first
-    failing reason (the trainer then runs the interleaved fallback).
+    """None when this member set can train as ONE batched sub-fleet;
+    else the first failing reason (the trainer then buckets by shape
+    signature, and only if a bucket still fails runs the interleaved
+    fallback).
 
     The gate admits exactly the configs whose sequential twin takes the
     leaf-wise ``_train_one_iter_fused`` path with uniform shapes across
-    models — what the vmapped round program replicates bit-for-bit."""
+    members — plain GBDT, GOSS, and DART alike, with or without
+    quantized histograms (their batched rounds replicate the sequential
+    twins bit-for-bit; RF reshapes scores host-side and stays out).
+    EVERY member is validated, not just member 0: a warm-started fleet
+    where model k diverges must be rejected, never silently trained
+    wrong in batched mode."""
+    from ..models.boosting_variants import DART, GOSS
     from ..models.gbdt import GBDT
     from ..ops.objectives import ObjectiveFunction
-    g0 = gbdts[0]
-    cfg0 = cfgs[0]
-    if type(g0) is not GBDT:
-        return f"boosting type {type(g0).__name__} (DART/GOSS/RF reshape " \
-               "scores or sampling host-side)"
-    if not g0.use_fused or type(g0.learner) is not DeviceTreeLearner:
-        return "fleet needs the single-device fused learner"
-    if cfg0.tpu_grow_mode not in ("leafwise", "auto"):
-        return f"tpu_grow_mode={cfg0.tpu_grow_mode!r} (the batched round " \
-               "replicates the leaf-wise fused path; set 'leafwise')"
-    if cfg0.tpu_grow_mode == "auto" \
-            and g0.learner.aligned_mode_ok(g0.objective):
-        return "tpu_grow_mode=auto resolves to the aligned pipeline " \
-               "here; set 'leafwise' to batch the fleet"
-    if cfg0.tpu_fuse_iteration:
-        return "tpu_fuse_iteration routes to the mega-fused single-model " \
-               "program"
-    if g0.objective is None:
-        return "custom-objective training has no device gradient program"
-    if type(g0.objective).get_gradients is not ObjectiveFunction.get_gradients:
-        return f"objective {g0.objective.name!r} composes gradients " \
-               "host-side"
-    if getattr(g0.objective, "is_renew_tree_output", False):
-        return "renew-tree-output objectives rewrite leaves host-side"
-    if not all(g0._class_need_train) or g0.train_data.num_features == 0:
-        return "constant-class iterations need the host constant-tree path"
-    if getattr(g0.learner, "quant_bits", 0):
-        return "quantized-histogram path threads a host qseq counter"
-    if cfg0.sequential_device_only:
-        return "forced splits / CEGB depend on host commit order"
-    if g0._balanced_bagging:
-        return "balanced bagging draws per-class counts (non-uniform " \
-               "partition shapes)"
-    base = shared_grid_signature(cfg0)
+    base = shared_grid_signature(cfgs[0])
     for m, cfg in enumerate(cfgs[1:], start=1):
         if shared_grid_signature(cfg) != base:
             diff = [k for (k, a), (_, b) in
                     zip(shared_grid_signature(cfg), base) if a != b]
             return f"model {m} differs outside the sweep grid: {diff[:4]}"
-    bag0 = gbdts[0]._will_bag()
-    if any(g._will_bag() != bag0 for g in gbdts):
-        return "mixed bagged/unbagged fleet (bagging_fraction uniform " \
-               "with varying freq/seed is supported)"
+    kind = type(gbdts[0])
+    for m, (g, cfg) in enumerate(zip(gbdts, cfgs)):
+        if type(g) not in (GBDT, GOSS, DART):
+            return f"model {m}: boosting type {type(g).__name__} " \
+                   "(RF reshapes scores host-side)"
+        if type(g) is not kind:
+            return f"model {m}: mixed boosting types across the fleet"
+        if not g.use_fused or type(g.learner) is not DeviceTreeLearner:
+            return f"model {m}: fleet needs the single-device fused " \
+                   "learner"
+        if cfg.tpu_grow_mode not in ("leafwise", "auto"):
+            return f"model {m}: tpu_grow_mode={cfg.tpu_grow_mode!r} " \
+                   "(the batched round replicates the leaf-wise fused " \
+                   "path; set 'leafwise')"
+        if cfg.tpu_grow_mode == "auto" \
+                and g.learner.aligned_mode_ok(g.objective):
+            return f"model {m}: tpu_grow_mode=auto resolves to the " \
+                   "aligned pipeline here; set 'leafwise' to batch the " \
+                   "fleet"
+        if cfg.tpu_fuse_iteration:
+            return f"model {m}: tpu_fuse_iteration routes to the " \
+                   "mega-fused single-model program"
+        if g.objective is None:
+            return f"model {m}: custom-objective training has no device " \
+                   "gradient program"
+        gg = g.objective.get_gradients
+        if getattr(gg, "__func__", gg) \
+                is not ObjectiveFunction.get_gradients:
+            return f"model {m}: objective {g.objective.name!r} composes " \
+                   "gradients host-side"
+        if getattr(g.objective, "is_renew_tree_output", False):
+            return f"model {m}: renew-tree-output objectives rewrite " \
+                   "leaves host-side"
+        if not all(g._class_need_train) or g.train_data.num_features == 0:
+            return f"model {m}: constant-class iterations need the host " \
+                   "constant-tree path"
+        if cfg.sequential_device_only:
+            return f"model {m}: forced splits / CEGB depend on host " \
+                   "commit order"
+        if type(g) is not GOSS and g._balanced_bagging:
+            return f"model {m}: balanced bagging draws per-class counts " \
+                   "(non-uniform partition shapes)"
+    if kind is not GOSS:
+        # GOSS ignores bagging_fraction/freq entirely (its sampling is
+        # the per-round top-k selection), so the uniformity requirement
+        # only applies to the standard bagging path
+        bag0 = gbdts[0]._will_bag()
+        if any(g._will_bag() != bag0 for g in gbdts):
+            return "mixed bagged/unbagged fleet (bagging_fraction " \
+                   "uniform with varying freq/seed is supported)"
     return None
 
 
 def make_round_program(learner: DeviceTreeLearner, objective,
                        M: int, K: int, num_leaves: int,
-                       bagged: bool, bag_cnt: int):
+                       bagged: bool, bag_cnt: int,
+                       variant: str = "gbdt", quant: bool = False):
     """The fleet's per-round program ``fn(scores, fmasks, lr, l1, l2,
-    l2c[, idx, bc], bins, bins_T) -> (scores', (rec_0..rec_{K-1}))``,
-    registered process-wide.
+    l2c[, idx, bc][, mult, warm][, qs], bins, bins_T) -> (scores',
+    (rec_0..rec_{K-1}))``, registered process-wide.
 
     Operand shapes: scores [M, K, N] (donated), fmasks [M, K, F] f32,
     lr/l1/l2/l2c [M] f32, idx [M, n_pad] int32 + bc [M] int32 (bagged
-    only). Returned records are TreeRecords with a leading model axis.
+    only), mult [M, N] f32 + warm [M] bool (GOSS only), qs [M] int32
+    (quantized histograms only — the per-model round counter; class k's
+    build consumes ``qs + k + 1``, the exact sequence the sequential
+    host counter hands out). Returned records are TreeRecords with a
+    leading model axis.
+
+    GOSS runs the BAGGED program shape at ``root_padded = pow2ceil(n)``:
+    the whole-tree build is bitwise invariant to root padding (the
+    routing masks ``pos < count`` everywhere), so one static program
+    covers every per-round sampled count AND the warm-up rounds (raw
+    identity partitions), with ``warm`` selecting the fresh-tree
+    partition-fill score lane those rounds use sequentially.
     """
     n = learner.n
-    root_count = bag_cnt if bagged else n
+    goss = variant == "goss"
+    root_count = n if goss else (bag_cnt if bagged else n)
     root_padded = max(_pow2ceil(root_count), learner.min_pad)
-    key = ("sweep_round", M, K, bagged, root_padded,
+    key = ("sweep_round", M, K, bagged, root_padded, variant, quant,
            _normalized_learner_sig(learner), objective.trace_signature())
 
     def factory():
@@ -161,47 +222,104 @@ def make_round_program(learner: DeviceTreeLearner, objective,
         boff = learner._boff_dev if bundled else None
         bpk = learner._bpk_dev if bundled else None
 
+        # operand names after the fixed (score, fmask, lr, l1, l2, l2c)
+        # prefix; bins/bins_T close the list unbatched
+        extra = (["idx", "bc"] if bagged else []) \
+            + (["mult", "warm"] if goss else []) \
+            + (["qs"] if quant else [])
+
         def classes(score, fmask, lr, l1, l2, l2c, bins, bins_T,
-                    idx=None, bc=None):
+                    idx=None, bc=None, mult=None, warm=None, qs=None):
             """One model's full round: gradients once (pre-update score,
             like the sequential round), then the per-class build +
             score-update chain in class order."""
             compile_cache.note_trace()
             g, h = objective.gradients_impl(score)
+            if mult is not None:
+                # GOSS re-weights the sampled small-gradient rows; warm
+                # rounds arrive with mult == 1.0 (x * 1.0 is bitwise x)
+                g = g * mult[None, :]
+                h = h * mult[None, :]
             recs = []
             new_score = score
             for k in range(K):
                 build = learner.sweep_build_fn(root_padded, not bagged,
                                                l1, l2, l2c)
+                opt = (qs + jnp.int32(k + 1),) if qs is not None else ()
                 if bagged:
                     idxs, rec = build(bins, bins_T, idx, g[k], h[k], bc,
-                                      fmask[k])
+                                      fmask[k], *opt)
                     # out-of-bag rows also need scores -> traversal
                     trav = traversal_arrays.__wrapped__(rec, Lm1)
-                    new_score = new_score.at[k].set(record_score_lane(
+                    s_bag = record_score_lane(
                         new_score[k], bins, trav, nb, db, mt, lr,
-                        col, boff, bpk))
+                        col, boff, bpk)
+                    if warm is not None:
+                        # GOSS warm-up rounds are fresh full-data trees
+                        # sequentially: partition fill, not traversal
+                        s_fresh = partition_score_update_lane(
+                            new_score, k, rec.leaf_begin,
+                            rec.leaf_cnt_part, rec.leaf_value, idxs,
+                            jnp.int32(n), lr)
+                        new_score = jnp.where(warm, s_fresh,
+                                              new_score.at[k].set(s_bag))
+                    else:
+                        new_score = new_score.at[k].set(s_bag)
                 else:
-                    idxs, rec = build(bins, bins_T, g[k], h[k], fmask[k])
+                    idxs, rec = build(bins, bins_T, g[k], h[k], fmask[k],
+                                      *opt)
                     new_score = partition_score_update_lane(
                         new_score, k, rec.leaf_begin, rec.leaf_cnt_part,
                         rec.leaf_value, idxs, jnp.int32(n), lr)
                 recs.append(rec)
             return new_score, tuple(recs)
 
-        if bagged:
-            def one_model(score, fmask, lr, l1, l2, l2c, idx, bc,
-                          bins, bins_T):
-                return classes(score, fmask, lr, l1, l2, l2c, bins,
-                               bins_T, idx=idx, bc=bc)
-            axes = (0, 0, 0, 0, 0, 0, 0, 0, None, None)
-        else:
-            def one_model(score, fmask, lr, l1, l2, l2c, bins, bins_T):
-                return classes(score, fmask, lr, l1, l2, l2c, bins,
-                               bins_T)
-            axes = (0, 0, 0, 0, 0, 0, None, None)
+        def one_model(*args):
+            score, fmask, lr, l1, l2, l2c = args[:6]
+            rest = dict(zip(extra, args[6:6 + len(extra)]))
+            bins, bins_T = args[6 + len(extra):]
+            return classes(score, fmask, lr, l1, l2, l2c, bins, bins_T,
+                           **rest)
+
+        axes = (0,) * (6 + len(extra)) + (None, None)
         return jax.jit(jax.vmap(one_model, in_axes=axes),
                        donate_argnums=(0,))
+
+    return compile_cache.program(key, factory), key
+
+
+def make_goss_select_program(learner: DeviceTreeLearner, objective,
+                             M: int, top_k: int, other_k: int):
+    """The fleet's GOSS selection program ``fn(scores, seeds, warm) ->
+    (mask [M, N] bool, mult [M, N] f32)``, registered process-wide.
+
+    One model's lane is the raw body of the sequential device select
+    (``boosting_variants.GOSS._bagging``) fed from the fleet score stack
+    — gradients recomputed from the pre-round score (same values the
+    round program derives), |g*h| ranked, threshold at top_k, the rest
+    sampled by the other_k smallest uniform keys under the per-model
+    ``PRNGKey(seed)`` (seeds come from each model's host bagging RNG
+    stream in model order, preserving the sequential draw sequence).
+    Warm-up lanes (``warm[m]``, models still inside their
+    1/learning_rate ramp) neutralize to the full-data identity: mask
+    all-true, mult all-ones, and the host draws no seed for them —
+    exactly the rounds the sequential twin skips sampling. Scores are
+    NOT donated (the round program still consumes them)."""
+    n = learner.n
+    key = ("sweep_goss_select", M, n, top_k, other_k,
+           _normalized_learner_sig(learner), objective.trace_signature())
+
+    def factory():
+        from ..models.boosting_variants import goss_select_body
+
+        def select(score, seed, warm):
+            compile_cache.note_trace()
+            g, h = objective.gradients_impl(score)
+            mask, mult = goss_select_body(g, h, seed, n, top_k, other_k)
+            mask = jnp.where(warm, True, mask)
+            mult = jnp.where(warm, jnp.float32(1.0), mult)
+            return mask, mult
+        return jax.jit(jax.vmap(select, in_axes=(0, 0, 0)))
 
     return compile_cache.program(key, factory), key
 

@@ -11,18 +11,96 @@ layout ``serving.registry.load_checkpoint_model_text`` reads — a
 watcher poll can never observe a torn model — which means the existing
 ``serving.watcher`` hot-swaps the refreshed fleet live with no new
 serving-side code.
+
+``RefreshTrigger`` closes the observe->retrain edge of the loop: it
+watches the per-model ``serve_slo_burn_rate`` signal the request tracer
+aggregates (obs/reqtrace.py) and enqueues models whose burn rate
+crosses the high watermark into the next refresh fleet, emitting a
+``sweep_refresh_triggered`` event per enqueue. ``refresh_due`` drains
+the queue into a ``refresh_many`` call covering only the burning
+members.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..basic import Booster, Dataset, LightGBMError
 from ..utils import log
 from .trainer import train_many
 
-__all__ = ["refresh_many", "write_serving_checkpoint"]
+__all__ = ["refresh_many", "write_serving_checkpoint", "RefreshTrigger",
+           "refresh_due"]
+
+
+class RefreshTrigger:
+    """Serving-signal watcher: burn-rate crossings -> refresh queue.
+
+    ``models[i]`` is fleet member i's serving-plane model name (the key
+    ``RequestTracer.burn_rates()`` reports). Feed it burn-rate
+    snapshots via ``observe`` (or ``poll(tracer)``); a member whose
+    rate reaches ``threshold`` is enqueued once (edge-triggered — it
+    re-arms when ``drain`` empties the queue, matching the tracer's own
+    ``serve_slo_burn`` hysteresis discipline) and announced with a
+    ``sweep_refresh_triggered`` event. ``drain`` hands the due fleet
+    indices to the next refresh cycle."""
+
+    def __init__(self, models: Sequence[str],
+                 threshold: Optional[float] = None) -> None:
+        from ..obs.reqtrace import SLO_BURN_HIGH
+        self.models = list(models)
+        self.threshold = float(SLO_BURN_HIGH if threshold is None
+                               else threshold)
+        self._index = {name: i for i, name in enumerate(self.models)}
+        self._due: Dict[int, float] = {}
+
+    def observe(self, burn_rates: Dict[str, float]) -> List[int]:
+        """Ingest one burn-rate snapshot; returns newly-enqueued fleet
+        indices (already-due members don't re-trigger)."""
+        fresh = []
+        for name, rate in burn_rates.items():
+            i = self._index.get(name)
+            if i is None or i in self._due or rate < self.threshold:
+                continue
+            self._due[i] = float(rate)
+            fresh.append(i)
+            log.event("sweep_refresh_triggered", model=name, index=i,
+                      burn_rate=round(float(rate), 4),
+                      threshold=self.threshold)
+        return fresh
+
+    def poll(self, tracer) -> List[int]:
+        """``observe`` straight off a live ``RequestTracer``."""
+        return self.observe(tracer.burn_rates())
+
+    def due(self) -> List[int]:
+        return sorted(self._due)
+
+    def drain(self) -> List[int]:
+        """Pop the queue (re-arming every drained member)."""
+        out = sorted(self._due)
+        self._due.clear()
+        return out
+
+
+def refresh_due(trigger: RefreshTrigger,
+                params_list: Sequence[Dict[str, Any]],
+                train_set: Dataset, serve_dirs: Sequence[str],
+                num_boost_round: int = 100
+                ) -> Tuple[List[int], List[Booster]]:
+    """Drain ``trigger`` and refresh exactly the burning members: the
+    due indices select the params/serve_dir subset handed to
+    ``refresh_many`` (warm-starting from the served versions as usual).
+    Returns ``(indices, refreshed boosters)`` — empty when nothing is
+    due, without touching the trainer."""
+    idx = trigger.drain()
+    if not idx:
+        return [], []
+    boosters = refresh_many([params_list[i] for i in idx], train_set,
+                            [serve_dirs[i] for i in idx],
+                            num_boost_round)
+    return idx, boosters
 
 
 def write_serving_checkpoint(directory: str, model_text: str) -> str:

@@ -1,0 +1,128 @@
+"""Shape-bucketed sub-fleet planning for the batched sweep.
+
+A fleet whose members disagree outside the sweep grid (different
+num_leaves, objective, quantization, ...) cannot share ONE vmapped
+round program — but it does not have to fall back to interleaved
+round-robin either. ``plan_subfleets`` partitions the fleet:
+
+1. **Shape buckets** — members are grouped by
+   ``shared_grid_signature`` (first-appearance order, original model
+   order preserved inside a bucket), the same pow2-bucketing idiom the
+   serving ForestEngine uses for mixed-shape forests: few distinct
+   program shapes, each reused across every sub-fleet of that shape.
+2. **HBM packing** — each bucket is chunked greedily by the device
+   headroom the ``obs/memory`` accountant reports (or the
+   ``tpu_sweep_hbm_budget_mb`` / ``tpu_sweep_max_fleet`` knobs when
+   set, e.g. on CPU CI where the runtime has no memory_stats): the
+   ``[M, K, N]`` score stack plus working headroom must fit, so
+   M-in-the-hundreds fleets split into pow2-sized chunks (program reuse
+   again: a 128-model bucket at cap 48 becomes four M=32 sub-fleets,
+   ONE trace).
+
+The trainer gates each sub-fleet independently and steps them
+round-robin per round, so the async dispatch queue stays full across
+sub-fleets exactly like the interleaved fallback keeps it full across
+models. The plan is a pure function of (signatures, shapes, caps) —
+deterministic across runs, asserted by tests/test_sweep_variants.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# score stack + record log / operand growth allowance per model
+_SCORE_HEADROOM = 2.0
+# fraction of the accountant's free HBM a fleet may claim
+_HBM_FRACTION = 0.8
+
+
+@dataclass(frozen=True)
+class SubfleetPlan:
+    """One batched sub-fleet: global model indices (fleet order), the
+    per-round score-stack bytes, and why the boundary exists."""
+    indices: Tuple[int, ...]
+    score_bytes: int
+    reason: str        # "single" | "shape" | "hbm" | "cap"
+
+
+def _model_bytes(gbdt) -> int:
+    """Per-model resident estimate for fleet packing: the [K, N] f32
+    score plane times a working-headroom factor (the record log and the
+    per-round operand stacks grow with the same M)."""
+    k = gbdt.num_tree_per_iteration
+    return int(k * gbdt.num_data * 4 * _SCORE_HEADROOM)
+
+
+def _budget_bytes(cfg) -> Tuple[Optional[int], str]:
+    """(budget, source): the explicit knob when set, else the device
+    accountant's free HBM, else None (unbounded — CPU emulation with no
+    memory_stats and no knob)."""
+    mb = int(getattr(cfg, "tpu_sweep_hbm_budget_mb", 0) or 0)
+    if mb > 0:
+        return mb * (1 << 20), "knob"
+    from ..obs import memory as obs_memory
+    stats = obs_memory.device_memory_stats()
+    if stats and stats.get("bytes_limit"):
+        free = int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+        return max(int(free * _HBM_FRACTION), 0), "hbm"
+    return None, "none"
+
+
+def _chunk_sizes(count: int, cap: int) -> List[int]:
+    """Greedy pow2 chunking: largest power of two <= cap repeatedly,
+    remainder as-is. Pow2 sizes keep the set of distinct (M, shape)
+    program keys small, so sub-fleet #2.. of a bucket reuse sub-fleet
+    #1's trace."""
+    if count <= cap:
+        return [count]
+    size = 1 << (cap.bit_length() - 1)
+    sizes = []
+    left = count
+    while left > cap:
+        sizes.append(size)
+        left -= size
+    if left:
+        sizes.append(left)
+    return sizes
+
+
+def plan_subfleets(gbdts, cfgs) -> List[SubfleetPlan]:
+    """Partition the fleet into batched sub-fleets: shape buckets first,
+    then HBM/cap chunking inside each bucket. One plan covering the
+    whole fleet (reason "single") is the homogeneous fast path."""
+    from .batched import shared_grid_signature
+    groups: Dict[Tuple, List[int]] = {}
+    order: List[Tuple] = []
+    for m, cfg in enumerate(cfgs):
+        sig = shared_grid_signature(cfg)
+        if sig not in groups:
+            groups[sig] = []
+            order.append(sig)
+        groups[sig].append(m)
+
+    budget, source = _budget_bytes(cfgs[0])
+    max_fleet = int(getattr(cfgs[0], "tpu_sweep_max_fleet", 0) or 0)
+
+    plans: List[SubfleetPlan] = []
+    for sig in order:
+        idx = groups[sig]
+        per_model = _model_bytes(gbdts[idx[0]])
+        cap = len(idx)
+        reason = "shape" if len(order) > 1 else "single"
+        if budget is not None and budget // per_model < cap:
+            cap = max(int(budget // per_model), 1)
+            reason = "hbm"
+        if 0 < max_fleet < cap:
+            cap = max_fleet
+            reason = "cap"
+        pos = 0
+        for size in _chunk_sizes(len(idx), cap):
+            plans.append(SubfleetPlan(
+                indices=tuple(idx[pos:pos + size]),
+                score_bytes=per_model * size,
+                reason=reason))
+            pos += size
+    if len(plans) == 1:
+        plans = [SubfleetPlan(plans[0].indices, plans[0].score_bytes,
+                              "single")]
+    return plans

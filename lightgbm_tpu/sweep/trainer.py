@@ -1,34 +1,61 @@
-"""The fleet trainer: M boosters, one shared Dataset, one jitted round.
+"""The fleet trainer: M boosters, one shared Dataset, batched rounds.
 
 ``train_many`` is ``engine.train``'s many-model sibling. M "probe"
 Boosters are constructed exactly like sequential training boosters —
 they own the per-model Config, the host RNG streams (bagging / feature
-fraction), warm-start trees, and the round-0 ``boost_from_average``
-mutation — but in batched mode they never dispatch a training program.
-One registered round program (``sweep/batched.py``) advances ALL M
-score planes ``[M, K, N]`` per round, with the per-model learning rate,
-split lambdas, bagging partitions, and feature masks threaded as traced
-operands; the batched TreeRecords land in a central device log and
-``probe._gbdt.models`` holds lightweight ``_RecRef`` entries into it.
-Because the refs live in the probe's own model list, the sequential
-bookkeeping applies to the fleet unchanged: ``boost_from_average``'s
-empty-models gate closes after round 0, warm-start prepends stay ahead
-of new trees, and the 16-round deferred trailing-empty trim deletes
-from the same list with the same arithmetic. Export is ONE device_get
-of the whole log followed by the same model-string round-trip
-``engine.train`` performs.
+fraction / DART drops), warm-start trees, and the round-0
+``boost_from_average`` mutation — but in batched mode they never
+dispatch a per-model training program. The fleet is first partitioned
+into shape-bucketed SUB-FLEETS (``sweep/subfleet.py``: members sharing
+``shared_grid_signature`` group together; the ``obs/memory`` accountant
+or the ``tpu_sweep_hbm_budget_mb`` / ``tpu_sweep_max_fleet`` knobs chunk
+groups whose ``[M, K, N]`` score stack would blow the HBM budget, in
+pow2 sizes so programs are reused across chunks). Each sub-fleet runs
+one registered round program (``sweep/batched.py``) advancing all its
+score planes per round; sub-fleets step round-robin per round so the
+async dispatch queue stays full across them.
+
+Boosting variants train batched too:
+
+- **GOSS** — the per-round top-k selection is one extra registered
+  vmapped program over the fleet score stack; the keep-mask comes back
+  in a single pull, the re-weight multiplier stays on device as a round
+  operand, and per-model warm-up flags (models still inside their
+  1/learning_rate ramp draw no sample sequentially) select the
+  fresh-tree score lane inside the round program.
+- **DART** — the round program is the PLAIN one: drops and
+  renormalization are host-double leaf-value mutations whose rounding
+  is association-order sensitive, so byte-equality forces reusing the
+  sequential ``_dropping_trees``/``_normalize`` machinery verbatim per
+  model, with the model's fleet score slice swapped in around each
+  call. Records materialize every round (one batched pull for the
+  whole sub-fleet — M times fewer pulls than sequential DART) and the
+  per-round shrinkage is rebuilt into the LR operand.
+- **Quantized histograms** — the host qseq counter becomes a per-model
+  ``[M]`` round-counter operand.
+
+The batched TreeRecords land in a central device log and
+``probe._gbdt.models`` holds lightweight ``_RecRef`` entries into it
+(DART holds host Trees directly). Because the refs live in the probe's
+own model list, the sequential bookkeeping applies to the fleet
+unchanged: ``boost_from_average``'s empty-models gate closes after
+round 0, warm-start prepends stay ahead of new trees, and the 16-round
+deferred trailing-empty trim deletes from the same list with the same
+arithmetic. Export is ONE device_get of the whole log followed by the
+same model-string round-trip ``engine.train`` performs.
 
 Parity contract: under ``tpu_use_f64_hist`` the model text of fleet
 member m is byte-equal to ``engine.train`` with the same params
-(tests/test_sweep.py asserts it for plain / bagged / multiclass).
+(tests/test_sweep.py + tests/test_sweep_variants.py assert it for
+plain / bagged / multiclass / GOSS / DART / quantized fleets).
 
-Configs the batched gate rejects fall back to INTERLEAVED mode: the
-probes train for real, one round each in round-robin order, so the
-async dispatch queue stays full across models while per-model programs
-keep their own shapes. Both modes share the fleet checkpoint format
+Configs the batched gate rejects for every sub-fleet fall back to
+INTERLEAVED mode: the probes train for real, one round each in
+round-robin order. Both modes share the fleet checkpoint format
 (``tpu_sweep_checkpoint_dir`` / ``tpu_sweep_checkpoint_freq``): model
-texts + score planes + host RNG + pending trim counters per model, so a
-preempted sweep resumes bitwise on either path.
+texts + per-model score planes + host RNG + pending trim counters (+
+DART tree weights) per model, so a preempted sweep resumes bitwise on
+either path.
 """
 from __future__ import annotations
 
@@ -44,9 +71,11 @@ import numpy as np
 from .. import compile_cache
 from ..basic import Booster, Dataset, LightGBMError
 from ..utils import log
-from .batched import batched_gate, lambda_operands, make_round_program
+from .batched import (batched_gate, lambda_operands,
+                      make_goss_select_program, make_round_program)
+from .subfleet import SubfleetPlan, plan_subfleets
 
-_FLEET_SCHEMA = 1
+_FLEET_SCHEMA = 2
 
 # trainer-level aliases engine.train also honors (reference sklearn.py
 # alias table); they must not leak into Config.from_params
@@ -74,8 +103,9 @@ class _RecRef:
 
 
 class _Fleet:
-    """Batched-run device state; also the HBM-accountant owner for the
-    stacked score buffer (obs/memory.py ``sweep/scores``)."""
+    """One sub-fleet's batched device state; also the HBM-accountant
+    owner for the stacked score buffer (obs/memory.py
+    ``sweep/scores``)."""
 
     def __init__(self, scores: jax.Array) -> None:
         self.scores = scores          # [M, K, N] f32, donated per round
@@ -90,15 +120,18 @@ def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
 
     Every params dict may vary the sweep grid fields
     (``sweep.SWEEP_VARYING``: learning_rate, lambda_l1/l2, bagging seed
-    and freq, feature_fraction_seed) freely; everything else must agree
-    across the fleet for batched mode — ``tpu_sweep_mode="auto"`` falls
-    back to the interleaved path otherwise, ``"batched"`` raises with
-    the gate's reason. ``init_models`` (per-model Booster / model file /
-    None) warm-starts members like ``engine.train(init_model=...)``;
-    it is ignored when resuming from ``tpu_sweep_checkpoint_dir`` (the
-    checkpointed texts already contain the seed trees). Returns M
-    independent Boosters round-tripped through their model strings,
-    exactly like ``engine.train``.
+    and freq, feature_fraction_seed, DART drop seed/rate/skip) freely;
+    members that differ elsewhere (num_leaves, objective, boosting
+    variant, ...) are bucketed into shape-shared sub-fleets, each its
+    own batched program. Only configs no sub-fleet can express fall
+    back to the interleaved path under ``tpu_sweep_mode="auto"``
+    (``"batched"`` raises with the gate's reason). ``init_models``
+    (per-model Booster / model file / None) warm-starts members like
+    ``engine.train(init_model=...)``; it is ignored when resuming from
+    ``tpu_sweep_checkpoint_dir`` (the checkpointed texts already
+    contain the seed trees). Returns M independent Boosters
+    round-tripped through their model strings, exactly like
+    ``engine.train``.
     """
     if not params_list:
         raise LightGBMError("train_many needs at least one params dict")
@@ -148,7 +181,13 @@ def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
     mode = (cfg0.tpu_sweep_mode or "auto").lower()
     if mode not in ("auto", "batched", "interleaved"):
         raise LightGBMError(f"unknown tpu_sweep_mode={mode!r}")
-    reason = batched_gate(gbdts, cfgs)
+    plans = plan_subfleets(gbdts, cfgs)
+    reason = None
+    for plan in plans:
+        reason = batched_gate([gbdts[i] for i in plan.indices],
+                              [cfgs[i] for i in plan.indices])
+        if reason is not None:
+            break
     if mode == "batched" and reason is not None:
         raise LightGBMError(f"tpu_sweep_mode=batched rejected: {reason}")
     use_batched = mode != "interleaved" and reason is None
@@ -160,6 +199,8 @@ def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
 
     fields: Dict[str, Any] = {"models": M, "mode": chosen,
                               "rounds": int(num_boost_round)}
+    if use_batched:
+        fields["subfleets"] = len(plans)
     if not use_batched and reason is not None:
         fields["fallback_reason"] = reason
     log.event("sweep_init", **fields)
@@ -169,7 +210,8 @@ def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
     try:
         if use_batched:
             out = _train_batched(probes, gbdts, cfgs, clean_params,
-                                 int(num_boost_round), ledger, loaded)
+                                 int(num_boost_round), ledger, loaded,
+                                 plans)
         else:
             out = _train_interleaved(probes, gbdts, cfgs, clean_params,
                                      int(num_boost_round), loaded)
@@ -192,151 +234,408 @@ def train_many(params_list: Sequence[Dict[str, Any]], train_set: Dataset,
 # batched path
 # ----------------------------------------------------------------------
 
-def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
-                   ledger, loaded) -> List[Booster]:
-    from ..models.device_learner import _pow2ceil
-    from ..obs import memory as obs_memory
-    from ..ops.sweep_ops import stacked_bag_partitions
-    g0 = gbdts[0]
-    lrn = g0.learner
-    cfg0 = cfgs[0]
-    M, K, F = len(probes), g0.num_tree_per_iteration, lrn.num_features
-    bagged = g0._will_bag()
-    bag_cnt = int(cfg0.bagging_fraction * g0.num_data) if bagged \
-        else g0.num_data
-    fn, _key = make_round_program(lrn, g0.objective, M, K,
-                                  cfg0.num_leaves, bagged, bag_cnt)
+class _BatchedRun:
+    """One sub-fleet's stepping state: its registered round program,
+    stacked score buffer, per-model round bookkeeping, and the
+    variant-specific host schedule. ``step(r)`` advances every member
+    one round; the trainer steps all runs round-robin so sub-fleet #2's
+    host work overlaps sub-fleet #1's device work."""
 
-    start_round = 0
-    iters = [0] * M
-    pending: List[Any] = []     # one [M] num_splits vector per (round, k)
-    biases = [[0.0] * K for _ in range(M)]
-    first_fresh = loaded is None
-    if loaded is not None:
-        state, texts, arrays = loaded
-        start_round = _fleet_resume(state, texts, arrays, gbdts, cfgs)
-        iters = [int(x) for x in state["iters"]]
+    def __init__(self, sid: int, plan: SubfleetPlan, probes, gbdts,
+                 cfgs, ledger) -> None:
+        from ..models.boosting_variants import DART, GOSS
+        from ..models.device_learner import _pow2ceil
+        self.sid = sid
+        self.plan = plan
+        self.idx = list(plan.indices)   # global model indices
+        self.probes, self.gbdts, self.cfgs = probes, gbdts, cfgs
+        g0 = gbdts[0]
+        self.lrn = g0.learner
+        self.cfg0 = cfgs[0]
+        self.M = len(gbdts)
+        self.K = g0.num_tree_per_iteration
+        self.F = self.lrn.num_features
+        self.n = g0.num_data
+        self.dart = type(g0) is DART
+        self.goss = type(g0) is GOSS
+        # vmap over a size-1 model axis lets XLA collapse the batch dim
+        # and re-associate the arithmetic, breaking bitwise parity with
+        # the M>=2 programs AND the sequential twin. Pad single-model
+        # sub-fleets with a ghost lane (lane 0's operands duplicated,
+        # outputs ignored) — which also makes them share the real M=2
+        # program's trace.
+        self.ghost = self.M == 1
+        self.Mp = 2 if self.ghost else self.M
+        self.variant = "goss" if self.goss else "gbdt"
+        self.quant = bool(getattr(self.lrn, "quant_bits", 0))
+        self.bagged = True if self.goss else g0._will_bag()
+        self.bag_cnt = self.n if self.goss or not self.bagged \
+            else int(self.cfg0.bagging_fraction * self.n)
+        self.iters = [0] * self.M
+        self.pending: List[Any] = []
+        self.stopped = [False] * self.M
+        self.biases = [[0.0] * self.K for _ in range(self.M)]
+        self.first_fresh = True
+        self.ledger = ledger
+        self.fleet: Optional[_Fleet] = None
+        self.select_fn = None
+        self._ones_mult = None
+        self._identity = np.arange(self.n, dtype=np.int32)
+        if self.goss:
+            self.warm_limits = [int(1.0 / c.learning_rate) for c in cfgs]
+            self.top_k = max(1, int(self.n * self.cfg0.top_rate))
+            self.other_k = max(1, int(self.n * self.cfg0.other_rate))
+        self.idx_pad = self.lrn.n + max(_pow2ceil(self.lrn.n),
+                                        self.lrn.min_pad)
+
+    # -- lifecycle ------------------------------------------------------
+    def init_fresh(self) -> None:
+        """Round-0 init exactly like the sequential loop head: the
+        boost_from_average gate self-closes once refs land in
+        probe.models."""
+        for m, g in enumerate(self.gbdts):
+            for k in range(self.K):
+                self.biases[m][k] = g.boost_from_average(k)
+
+    def resume(self, state) -> None:
+        """Per-run slices of the global checkpoint state (scores / RNG /
+        trees were already installed on the probes by _fleet_resume)."""
+        self.first_fresh = False
+        self.iters = [int(state["iters"][i]) for i in self.idx]
+        self.stopped = [bool(s) for s in
+                        [state.get("stopped", [False] * 10 ** 6)[i]
+                         for i in self.idx]]
         per_model = state["pending"]
-        depth = len(per_model[0]) if per_model and per_model[0] else 0
-        pending = [np.asarray([int(per_model[m][i]) for m in range(M)],
-                              np.int32) for i in range(depth)]
-    else:
-        # round-0 init exactly like the sequential loop head: the gate
-        # self-closes once the refs land in probe.models
-        for m, g in enumerate(gbdts):
-            for k in range(K):
-                biases[m][k] = g.boost_from_average(k)
+        depth = len(per_model[self.idx[0]])
+        self.pending = [
+            np.asarray([int(per_model[i][d]) for i in self.idx], np.int32)
+            for d in range(depth)]
 
-    fleet = _Fleet(jnp.stack([g.train_score.score for g in gbdts]))
-    for g in gbdts:
-        # the fleet buffer owns the training scores now; drop the
-        # per-probe planes so HBM holds one fleet copy, not two
-        g.train_score.score = g.train_score.score[:, :0]
-    obs_memory.track("sweep/scores", fleet,
-                     lambda fl: int(fl.scores.nbytes))
+    def start(self) -> None:
+        from ..obs import memory as obs_memory
+        g0 = self.gbdts[0]
+        self.fn, _key = make_round_program(
+            self.lrn, g0.objective, self.Mp, self.K,
+            self.cfg0.num_leaves, self.bagged, self.bag_cnt,
+            variant=self.variant, quant=self.quant)
+        self.fleet = _Fleet(self._pad(jnp.stack(
+            [g.train_score.score for g in self.gbdts])))
+        for g in self.gbdts:
+            # the fleet buffer owns the training scores now; drop the
+            # per-probe planes so HBM holds one fleet copy, not two
+            g.train_score.score = g.train_score.score[:, :0]
+        name = "sweep/scores" if self.sid == 0 \
+            else f"sweep/scores/{self.sid}"
+        obs_memory.track(name, self.fleet,
+                         lambda fl: int(fl.scores.nbytes))
+        self.LR = self._pad(jnp.asarray(
+            [np.float32(g.shrinkage_rate) for g in self.gbdts],
+            jnp.float32))
+        l1, l2, l2c = lambda_operands(self.cfgs)
+        self.L1, self.L2, self.L2C = (self._pad(jnp.asarray(l1)),
+                                      self._pad(jnp.asarray(l2)),
+                                      self._pad(jnp.asarray(l2c)))
+        self.bins, self.bins_T = self.lrn.bins_dev, self.lrn.bins_T_dev
+        log.event("sweep_subfleet", index=self.sid, models=self.idx,
+                  size=self.M, reason=self.plan.reason,
+                  score_mb=round(self.plan.score_bytes / (1 << 20), 2),
+                  variant="dart" if self.dart else self.variant,
+                  quant=self.quant)
 
-    LR = jnp.asarray([np.float32(g.shrinkage_rate) for g in gbdts],
-                     jnp.float32)
-    l1, l2, l2c = lambda_operands(cfgs)
-    L1, L2, L2C = jnp.asarray(l1), jnp.asarray(l2), jnp.asarray(l2c)
-    bins, bins_T = lrn.bins_dev, lrn.bins_T_dev
-    idx_pad = lrn.n + max(_pow2ceil(lrn.n), lrn.min_pad)
-    ckpt_freq = int(cfg0.tpu_sweep_checkpoint_freq or 0)
+    def _pad(self, a):
+        """Duplicate lane 0 into the ghost lane of an [M]-leading
+        operand (no-op for real M>=2 sub-fleets)."""
+        if not self.ghost:
+            return a
+        a = jnp.asarray(a)
+        return jnp.concatenate([a, a[:1]], axis=0)
 
-    for r in range(start_round, num_boost_round):
-        rnd_iters = list(iters)
-        traces_before = compile_cache.trace_count()
-        t0 = time.perf_counter()
-        if bagged:
-            # host RNG schedule in sequential order: bag redraw first,
-            # then the per-class feature masks (\_train_one_iter_impl)
-            for m, g in enumerate(gbdts):
-                g._bagging(iters[m])
-            IDX = stacked_bag_partitions(
-                [g.bag_data_indices for g in gbdts], idx_pad)
-            BC = jnp.asarray([int(g.bag_data_cnt) for g in gbdts],
-                             jnp.int32)
-        FM = np.empty((M, K, F), np.float32)
-        for m, g in enumerate(gbdts):
-            for k in range(K):
+    # -- per-round host schedules --------------------------------------
+    def _feature_masks(self, skip=None) -> np.ndarray:
+        FM = np.empty((self.M, self.K, self.F), np.float32)
+        for m, g in enumerate(self.gbdts):
+            if skip is not None and skip[m]:
+                # stopped members draw no RNG (sequential twins stopped
+                # training); their lane trains on a full mask, discarded
+                FM[m] = 1.0
+                continue
+            for k in range(self.K):
                 fm = g.learner.feature_mask()
                 FM[m, k, :] = 1.0 if fm is None \
                     else fm.astype(np.float32)
-        if bagged:
-            fleet.scores, recs = fn(fleet.scores, jnp.asarray(FM), LR,
-                                    L1, L2, L2C, IDX, BC, bins, bins_T)
-        else:
-            fleet.scores, recs = fn(fleet.scores, jnp.asarray(FM), LR,
-                                    L1, L2, L2C, bins, bins_T)
-        fleet.rec_log.append(recs)
-        entry = len(fleet.rec_log) - 1
+        return FM
+
+    def _goss_operands(self, r) -> List[Any]:
+        """GOSS host schedule, sequential order per model: the warm-up
+        check against this model's 1/learning_rate ramp, one bag-RNG
+        seed draw for sampling models only, then the device top-k
+        select (one program for the sub-fleet, one mask pull)."""
+        from ..ops.sweep_ops import stacked_bag_partitions
+        gbdts = self.gbdts
+        warm = np.asarray([self.iters[m] < self.warm_limits[m]
+                           for m in range(self.M)], bool)
+        seeds = np.zeros(self.M, np.uint32)
         for m, g in enumerate(gbdts):
-            for k in range(K):
+            g._goss_multiplier = None
+            if warm[m]:
+                g.bag_data_indices = None
+                g.bag_data_cnt = self.n
+            else:
+                seeds[m] = np.uint32(g._bag_rng.randint(0, 2 ** 31 - 1))
+        WARM = self._pad(jnp.asarray(warm))
+        if bool(warm.all()):
+            if self._ones_mult is None:
+                self._ones_mult = jnp.ones((self.Mp, self.n),
+                                           jnp.float32)
+            MULT = self._ones_mult
+            idx_list = [self._identity] * self.M
+            bc = [self.n] * self.M
+        else:
+            if self.select_fn is None:
+                self.select_fn, _ = make_goss_select_program(
+                    self.lrn, gbdts[0].objective, self.Mp, self.top_k,
+                    self.other_k)
+            mask_dev, MULT = self.select_fn(
+                self.fleet.scores, self._pad(jnp.asarray(seeds)), WARM)
+            masks = np.asarray(jax.device_get(mask_dev))
+            idx_list, bc = [], []
+            for m, g in enumerate(gbdts):
+                if warm[m]:
+                    idx_list.append(self._identity)
+                    bc.append(self.n)
+                else:
+                    sel = np.nonzero(masks[m])[0].astype(np.int32)
+                    g.bag_data_indices = sel
+                    g.bag_data_cnt = len(sel)
+                    idx_list.append(sel)
+                    bc.append(len(sel))
+        IDX = self._pad(stacked_bag_partitions(idx_list, self.idx_pad))
+        return [IDX, self._pad(jnp.asarray(bc, jnp.int32)), MULT, WARM]
+
+    def _bag_operands(self) -> List[Any]:
+        from ..ops.sweep_ops import stacked_bag_partitions
+        # host RNG schedule in sequential order: bag redraw first, then
+        # the per-class feature masks (_train_one_iter_impl)
+        for m, g in enumerate(self.gbdts):
+            if not self.stopped[m]:
+                g._bagging(self.iters[m])
+        IDX = self._pad(stacked_bag_partitions(
+            [g.bag_data_indices for g in self.gbdts], self.idx_pad))
+        BC = self._pad(jnp.asarray(
+            [int(g.bag_data_cnt) for g in self.gbdts], jnp.int32))
+        return [IDX, BC]
+
+    # -- stepping -------------------------------------------------------
+    def step(self, r: int) -> None:
+        if self.dart:
+            self._step_dart(r)
+        else:
+            self._step_plain(r)
+
+    def _step_plain(self, r: int) -> None:
+        gbdts = self.gbdts
+        rnd_iters = list(self.iters)
+        traces_before = compile_cache.trace_count()
+        t0 = time.perf_counter()
+        if self.goss:
+            extras = self._goss_operands(r)
+        elif self.bagged:
+            extras = self._bag_operands()
+        else:
+            extras = []
+        FM = self._pad(jnp.asarray(self._feature_masks()))
+        if self.quant:
+            extras.append(jnp.full((self.Mp,), r * self.K, jnp.int32))
+        self.fleet.scores, recs = self.fn(
+            self.fleet.scores, FM, self.LR, self.L1,
+            self.L2, self.L2C, *extras, self.bins, self.bins_T)
+        self.fleet.rec_log.append(recs)
+        entry = len(self.fleet.rec_log) - 1
+        for m, g in enumerate(gbdts):
+            for k in range(self.K):
                 g.models.append(_RecRef(
                     entry, k, float(g.shrinkage_rate),
-                    biases[m][k] if first_fresh else 0.0))
-            iters[m] += 1
-        first_fresh = False
-        for k in range(K):
-            pending.append(recs[k].num_splits)
+                    self.biases[m][k] if self.first_fresh else 0.0))
+            self.iters[m] += 1
+        self.first_fresh = False
+        for k in range(self.K):
+            self.pending.append(recs[k].num_splits)
         t_host = time.perf_counter()
 
         fenced = False
-        if len(pending) >= 16 * K:
+        if len(self.pending) >= 16 * self.K:
             # deferred trailing-empty trim, per model (the same batched
             # pull + arithmetic as gbdt._trim_trailing_empty)
-            ns = [np.asarray(x) for x in jax.device_get(pending)]
-            pending = []
+            ns = [np.asarray(x) for x in jax.device_get(self.pending)]
+            self.pending = []
             fenced = True
             for m, g in enumerate(gbdts):
                 col = [int(x[m]) for x in ns]
                 empty_trailing = 0
-                for it in range(len(col) // K - 1, -1, -1):
-                    if max(col[it * K:(it + 1) * K]) == 0:
+                for it in range(len(col) // self.K - 1, -1, -1):
+                    if max(col[it * self.K:(it + 1) * self.K]) == 0:
                         empty_trailing += 1
                     else:
                         break
-                if empty_trailing and len(g.models) > K:
-                    drop = min(empty_trailing * K, len(g.models) - K)
+                if empty_trailing and len(g.models) > self.K:
+                    drop = min(empty_trailing * self.K,
+                               len(g.models) - self.K)
                     del g.models[-drop:]
-                    iters[m] -= drop // K
+                    self.iters[m] -= drop // self.K
         t1 = time.perf_counter()
+        self._commit_ledger(rnd_iters, t0, t_host, t1, fenced,
+                            traces_before)
 
-        if ledger is not None:
-            wall = round((t1 - t0) * 1e3, 3)
-            dev = round((t1 - t_host) * 1e3, 3) if fenced else 0.0
-            traces_delta = compile_cache.trace_count() - traces_before
-            for m, g in enumerate(gbdts):
-                rec = {"kind": "round", "round": rnd_iters[m],
-                       "wall_ms": wall, "device_ms": dev,
-                       "traces": traces_delta if m == 0 else 0,
-                       "path": "sweep", "aligned": False, "fallbacks": 0,
-                       "trees": len(g.models), "model": m,
-                       "bag_cnt": int(g.bag_data_cnt) if bagged
-                       else int(g0.num_data)}
-                if fenced:
-                    rec["timing"] = "fenced"
-                    rec["terms_ms"] = {"sweep": dev}
-                ledger.commit(rec)
+    def _step_dart(self, r: int) -> None:
+        """One DART round: per-model host drops against the fleet score
+        slices, the PLAIN batched build with this round's shrinkage
+        operand, immediate materialization (one batched pull for the
+        sub-fleet), then per-model normalization — the sequential
+        dart.hpp machinery verbatim, so the host-double leaf mutation
+        chains stay byte-equal."""
+        from ..models.gbdt import K_EPSILON
+        gbdts = self.gbdts
+        rnd_iters = list(self.iters)
+        traces_before = compile_cache.trace_count()
+        t0 = time.perf_counter()
+        for m, g in enumerate(gbdts):
+            if self.stopped[m]:
+                continue
+            g.iter = self.iters[m]
+            g.train_score.score = self.fleet.scores[m]
+            g._dropping_trees()
+            self.fleet.scores = self.fleet.scores.at[m].set(
+                g.train_score.score)
+            g.train_score.score = g.train_score.score[:, :0]
+        LR = self._pad(jnp.asarray(
+            [np.float32(g.shrinkage_rate) for g in gbdts], jnp.float32))
+        extras = self._bag_operands() if self.bagged else []
+        FM = self._pad(jnp.asarray(self._feature_masks(skip=self.stopped)))
+        if self.quant:
+            extras.append(jnp.full((self.Mp,), r * self.K, jnp.int32))
+        self.fleet.scores, recs = self.fn(
+            self.fleet.scores, FM, LR, self.L1, self.L2,
+            self.L2C, *extras, self.bins, self.bins_T)
+        t_host = time.perf_counter()
+        host_recs = jax.device_get(recs)
+        for m, g in enumerate(gbdts):
+            if self.stopped[m]:
+                continue
+            shrink = float(g.shrinkage_rate)
+            trees = []
+            ns_max = 0
+            for k in range(self.K):
+                rec_m = jax.tree_util.tree_map(lambda a: a[m],
+                                               host_recs[k])
+                ns_max = max(ns_max, int(rec_m.num_splits))
+                tree = g.learner.record_to_tree(rec_m, shrink)
+                bias = self.biases[m][k] if self.first_fresh else 0.0
+                if abs(bias) > K_EPSILON:
+                    tree.add_bias(bias)
+                trees.append(tree)
+            if ns_max == 0 and len(g.models) > 0:
+                # reference immediate stop (dart train_one_iter): the
+                # no-split iteration is deleted and _normalize skipped —
+                # dropped trees stay negated, bug-compatibly
+                self.stopped[m] = True
+                continue
+            g.models.extend(trees)
+            self.iters[m] += 1
+            g.iter = self.iters[m]
+            g.train_score.score = self.fleet.scores[m]
+            g._normalize()
+            self.fleet.scores = self.fleet.scores.at[m].set(
+                g.train_score.score)
+            g.train_score.score = g.train_score.score[:, :0]
+            if not g.cfg.uniform_drop:
+                g.tree_weight.append(g.shrinkage_rate)
+                g.sum_weight += g.shrinkage_rate
+        self.first_fresh = False
+        t1 = time.perf_counter()
+        self._commit_ledger(rnd_iters, t0, t_host, t1, True,
+                            traces_before)
 
+    def _commit_ledger(self, rnd_iters, t0, t_host, t1, fenced,
+                       traces_before) -> None:
+        if self.ledger is None:
+            return
+        wall = round((t1 - t0) * 1e3, 3)
+        dev = round((t1 - t_host) * 1e3, 3) if fenced else 0.0
+        traces_delta = compile_cache.trace_count() - traces_before
+        for m, g in enumerate(self.gbdts):
+            rec = {"kind": "round", "round": rnd_iters[m],
+                   "wall_ms": wall, "device_ms": dev,
+                   "traces": traces_delta if m == 0 else 0,
+                   "path": "sweep", "aligned": False, "fallbacks": 0,
+                   "trees": len(g.models), "model": self.idx[m],
+                   "bag_cnt": int(g.bag_data_cnt)
+                   if self.bagged and g.bag_data_indices is not None
+                   else int(self.n)}
+            if fenced:
+                rec["timing"] = "fenced"
+                rec["terms_ms"] = {"sweep": dev}
+            self.ledger.commit(rec)
+
+    # -- export ---------------------------------------------------------
+    def finish(self) -> None:
+        """Resolve refs and hand each probe its final state; packaging
+        happens fleet-wide in _train_batched."""
+        trees_per_model = _materialize_fleet(self.gbdts,
+                                             self.fleet.rec_log)
+        for m, g in enumerate(self.gbdts):
+            g.models = trees_per_model[m]
+            g.iter = self.iters[m]
+            g._pending_numsplits = []
+            g.train_score.score = self.fleet.scores[m]
+
+
+def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
+                   ledger, loaded, plans) -> List[Booster]:
+    cfg0 = cfgs[0]
+    runs = [
+        _BatchedRun(s, plan,
+                    [probes[i] for i in plan.indices],
+                    [gbdts[i] for i in plan.indices],
+                    [cfgs[i] for i in plan.indices], ledger)
+        for s, plan in enumerate(plans)]
+
+    start_round = 0
+    if loaded is not None:
+        state, texts, arrays = loaded
+        layout = [list(p.indices) for p in plans]
+        if state.get("subfleets") != layout:
+            raise LightGBMError(
+                "sweep resume: checkpoint sub-fleet layout "
+                f"{state.get('subfleets')} does not match this run's "
+                f"{layout} (HBM budget / fleet knobs changed?)")
+        start_round = _fleet_resume(state, texts, arrays, gbdts, cfgs)
+        for run in runs:
+            run.resume(state)
+    else:
+        for run in runs:
+            run.init_fresh()
+    for run in runs:
+        run.start()
+
+    ckpt_freq = int(cfg0.tpu_sweep_checkpoint_freq or 0)
+    for r in range(start_round, num_boost_round):
+        # interleaved dispatch across sub-fleets: run #2's host schedule
+        # overlaps run #1's device round (async dispatch)
+        for run in runs:
+            run.step(r)
         if ckpt_freq > 0 and cfg0.tpu_sweep_checkpoint_dir \
                 and (r + 1) % ckpt_freq == 0:
             _write_batched_ckpt(cfg0.tpu_sweep_checkpoint_dir, r + 1,
-                                probes, gbdts, cfgs, iters, pending,
-                                fleet)
+                                probes, gbdts, cfgs, runs, plans)
 
-    # ONE device pull for every logged record, then the sequential
-    # export path per model
-    trees_per_model = _materialize_fleet(gbdts, fleet.rec_log)
-    scores_nbytes = int(fleet.scores.nbytes)
+    scores_nbytes = 0
+    for run in runs:
+        run.finish()
+        scores_nbytes += int(run.fleet.scores.nbytes)
     out = []
     for m, (probe, g) in enumerate(zip(probes, gbdts)):
-        g.models = trees_per_model[m]
-        g.iter = iters[m]
-        g._pending_numsplits = []
-        g.train_score.score = fleet.scores[m]
         bst = _package(probe, clean_params[m])
-        # the fleet (and its sweep/scores HBM owner row) dies with this
+        # the fleet (and its sweep/scores HBM owner rows) dies with this
         # frame; the stack size survives on the outputs for bench
         bst._sweep_scores_bytes = scores_nbytes
         out.append(bst)
@@ -345,7 +644,9 @@ def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
 
 def _materialize_fleet(gbdts, rec_log) -> List[List[Any]]:
     """Resolve every _RecRef in every probe's model list to a host Tree
-    with one batched device->host transfer of the whole record log."""
+    with one batched device->host transfer of the whole record log.
+    Entries that are already host Trees (DART materializes per round;
+    warm-start seeds) pass through untouched."""
     host_log = jax.device_get(rec_log) if rec_log else []
     from ..models.gbdt import K_EPSILON
     out = []
@@ -395,7 +696,7 @@ def _train_interleaved(probes, gbdts, cfgs, clean_params, num_boost_round,
         if ckpt_freq > 0 and cfg0.tpu_sweep_checkpoint_dir \
                 and (r + 1) % ckpt_freq == 0:
             texts = [p.model_to_string() for p in probes]
-            scores = jnp.stack([g.train_score.score for g in gbdts])
+            scores = [g.train_score.score for g in gbdts]
             pend = [[int(x) for x in
                      jax.device_get(list(g._pending_numsplits))]
                     for g in gbdts]
@@ -411,28 +712,43 @@ def _train_interleaved(probes, gbdts, cfgs, clean_params, num_boost_round,
 # ----------------------------------------------------------------------
 
 def _write_batched_ckpt(directory, round_next, probes, gbdts, cfgs,
-                        iters, pending, fleet) -> None:
+                        runs, plans) -> None:
     """Snapshot mid-sweep batched state. Trees are materialized into
     COPIES (the live _RecRef entries stay untouched) and serialized per
     model; pending trim counters are pulled but NOT cleared, so the
     trim cadence after resume matches the uninterrupted run."""
-    trees_per_model = _materialize_fleet(gbdts, fleet.rec_log)
-    texts = []
-    for probe, g, trees in zip(probes, gbdts, trees_per_model):
-        live = g.models
-        g.models = trees
-        try:
-            texts.append(probe.model_to_string())
-        finally:
-            g.models = live
-    ns = [np.asarray(x) for x in jax.device_get(list(pending))]
-    pend = [[int(x[m]) for x in ns] for m in range(len(gbdts))]
+    M = len(gbdts)
+    texts: List[str] = [""] * M
+    scores: List[np.ndarray] = [None] * M
+    iters = [0] * M
+    pend: List[List[int]] = [[] for _ in range(M)]
+    stopped = [False] * M
+    for run in runs:
+        trees_per_model = _materialize_fleet(run.gbdts, run.fleet.rec_log)
+        host_stack = np.asarray(jax.device_get(run.fleet.scores),
+                                np.float32)
+        ns = [np.asarray(x) for x in jax.device_get(list(run.pending))]
+        for j, i in enumerate(run.idx):
+            probe, g = run.probes[j], run.gbdts[j]
+            live = g.models
+            g.models = trees_per_model[j]
+            try:
+                texts[i] = probe.model_to_string()
+            finally:
+                g.models = live
+            scores[i] = host_stack[j]
+            iters[i] = run.iters[j]
+            pend[i] = [int(x[j]) for x in ns]
+            stopped[i] = run.stopped[j]
     _fleet_ckpt_write(directory, round_next, gbdts, cfgs, iters, pend,
-                      fleet.scores, "batched", texts)
+                      scores, "batched", texts, stopped=stopped,
+                      subfleets=[list(p.indices) for p in plans])
 
 
 def _fleet_ckpt_write(directory, round_next, gbdts, cfgs, iters, pend,
-                      scores, mode, texts) -> None:
+                      scores, mode, texts, stopped=None,
+                      subfleets=None) -> None:
+    from ..models.boosting_variants import DART, GOSS
     from ..resilience.checkpoint import (MANIFEST_NAME, atomic_write_text,
                                          capture_rng_states,
                                          training_signature)
@@ -440,21 +756,33 @@ def _fleet_ckpt_write(directory, round_next, gbdts, cfgs, iters, pend,
     cdir = os.path.join(directory, name)
     os.makedirs(cdir, exist_ok=True)
     for m, text in enumerate(texts):
-        atomic_write_text(os.path.join(cdir, f"model_{m:02d}.txt"), text)
-    arrays = {"scores": np.asarray(jax.device_get(scores), np.float32)}
-    if gbdts[0].bag_data_indices is not None:
-        arrays["bag_indices"] = np.stack(
-            [np.asarray(g.bag_data_indices, np.int32) for g in gbdts])
-        arrays["bag_cnt"] = np.asarray(
-            [int(g.bag_data_cnt) for g in gbdts], np.int32)
+        atomic_write_text(os.path.join(cdir, f"model_{m:04d}.txt"), text)
+    # per-model score planes (sub-fleets may have different [K, N])
+    arrays = {f"score_{m:04d}": np.asarray(jax.device_get(s), np.float32)
+              for m, s in enumerate(scores)}
+    for m, g in enumerate(gbdts):
+        # standard bagging carries its subset across rounds (freq > 1);
+        # GOSS redraws every round, so nothing to persist
+        if not isinstance(g, GOSS) and g.bag_data_indices is not None:
+            arrays[f"bag_idx_{m:04d}"] = np.asarray(g.bag_data_indices,
+                                                    np.int32)
+            arrays[f"bag_cnt_{m:04d}"] = np.asarray(
+                [int(g.bag_data_cnt)], np.int32)
     tmp = os.path.join(cdir, ".arrays.npz.tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, os.path.join(cdir, "arrays.npz"))
+    dart_state = [
+        {"tree_weight": [float(w) for w in g.tree_weight],
+         "sum_weight": float(g.sum_weight)}
+        if isinstance(g, DART) else None for g in gbdts]
     state = {"sweep_schema": _FLEET_SCHEMA, "round": int(round_next),
              "mode": mode, "models": len(gbdts),
              "iters": [int(x) for x in iters],
              "pending": pend,
+             "stopped": [bool(x) for x in (stopped or [False] * len(gbdts))],
+             "subfleets": subfleets,
+             "dart": dart_state,
              "rng": [capture_rng_states(g) for g in gbdts],
              "signatures": [training_signature(cfg) for cfg in cfgs]}
     atomic_write_text(os.path.join(cdir, "state.json"),
@@ -479,7 +807,7 @@ def _fleet_ckpt_load(directory):
             f"sweep resume: unknown checkpoint schema in {cdir}")
     texts = []
     for m in range(int(state["models"])):
-        with open(os.path.join(cdir, f"model_{m:02d}.txt")) as f:
+        with open(os.path.join(cdir, f"model_{m:04d}.txt")) as f:
             texts.append(f.read())
     arrays = dict(np.load(os.path.join(cdir, "arrays.npz")))
     return state, texts, arrays
@@ -487,8 +815,9 @@ def _fleet_ckpt_load(directory):
 
 def _fleet_resume(state, texts, arrays, gbdts, cfgs) -> int:
     """Install checkpointed per-model state onto the probe GBDTs; the
-    caller restores mode-specific extras (iters/pending). Returns the
-    round index to continue from."""
+    caller restores mode-specific extras (iters/pending/stopped).
+    Returns the round index to continue from."""
+    from ..models.boosting_variants import DART
     from ..resilience.checkpoint import (install_rng_states,
                                          training_signature)
     for m, cfg in enumerate(cfgs):
@@ -496,13 +825,17 @@ def _fleet_resume(state, texts, arrays, gbdts, cfgs) -> int:
             raise LightGBMError(
                 f"sweep resume: model {m}'s config no longer matches the "
                 "checkpoint's training signature")
-    scores = arrays["scores"]
+    dart_state = state.get("dart") or [None] * len(gbdts)
     for m, g in enumerate(gbdts):
         g.models = list(Booster(model_str=texts[m]).trees)
-        g.train_score.score = jnp.asarray(scores[m])
+        g.train_score.score = jnp.asarray(arrays[f"score_{m:04d}"])
         install_rng_states(g, state["rng"][m])
-        if "bag_indices" in arrays:
-            g.bag_data_indices = np.asarray(arrays["bag_indices"][m],
+        if f"bag_idx_{m:04d}" in arrays:
+            g.bag_data_indices = np.asarray(arrays[f"bag_idx_{m:04d}"],
                                             np.int32)
-            g.bag_data_cnt = int(arrays["bag_cnt"][m])
+            g.bag_data_cnt = int(arrays[f"bag_cnt_{m:04d}"][0])
+        if isinstance(g, DART) and dart_state[m] is not None:
+            g.tree_weight = [float(w)
+                             for w in dart_state[m]["tree_weight"]]
+            g.sum_weight = float(dart_state[m]["sum_weight"])
     return int(state["round"])

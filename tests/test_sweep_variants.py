@@ -1,0 +1,300 @@
+"""Boosting variants in the batched sweep (ISSUE 18): GOSS / DART /
+quantized-histogram fleets byte-equal to their sequential twins, the
+per-member gate fix, sub-fleet bucketing determinism + chunked-fleet
+byte-equality, zero-retrace for variant fleet #2, and the serving-signal
+refresh trigger.
+
+The byte-equality fleet trainings are marked slow (each trains a
+batched fleet plus M sequential twins — compile-heavy on the emulated
+device); the CI full tier runs them, tier-1 keeps the cheap gate /
+planner / trigger / zero-retrace checks.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import compile_cache
+from lightgbm_tpu.sweep import (RefreshTrigger, batched_gate,
+                                plan_subfleets, train_many)
+from lightgbm_tpu.sweep.subfleet import _chunk_sizes
+
+BASE = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+        "tpu_use_f64_hist": True, "tpu_grow_mode": "leafwise",
+        "verbosity": -1}
+
+
+def _data(seed=7, n=400, f=12):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, f // 2] - X[:, f - 1]
+         + rng.rand(n) * 0.1).astype(np.float32)
+    return X, y
+
+
+def _texts(boosters):
+    return [b.model_to_string() for b in boosters]
+
+
+def _seq_texts(grids, X, y, rounds):
+    return [lgb.train(dict(p), lgb.Dataset(X, label=y),
+                      num_boost_round=rounds).model_to_string()
+            for p in grids]
+
+
+def _probes(grids, X, y):
+    boosters = [lgb.Booster(params=dict(p),
+                            train_set=lgb.Dataset(X, label=y))
+                for p in grids]
+    return [b._gbdt for b in boosters], [b._cfg for b in boosters]
+
+
+# ----------------------------------------------------------------------
+# byte-equality: batched variant fleets == sequential twins
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_batched_goss_byte_equal():
+    # learning rates straddle the warm-up ramp: lr=0.3 leaves warm-up at
+    # iteration 3, lr=0.05 stays inside it for the whole run, so the
+    # fleet mixes warm and sampling members every round
+    X, y = _data()
+    base = dict(BASE, boosting="goss", top_rate=0.2, other_rate=0.2)
+    grids = [dict(base, learning_rate=0.3),
+             dict(base, learning_rate=0.15, lambda_l2=1.0),
+             dict(base, learning_rate=0.1, lambda_l1=0.5),
+             dict(base, learning_rate=0.05)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 6)
+
+
+@pytest.mark.slow
+def test_batched_dart_byte_equal():
+    X, y = _data()
+    base = dict(BASE, boosting="dart", drop_rate=0.5, skip_drop=0.3)
+    grids = [dict(base, learning_rate=0.3),
+             dict(base, learning_rate=0.2, drop_seed=11),
+             dict(base, learning_rate=0.1, drop_rate=0.9, skip_drop=0.0),
+             dict(base, learning_rate=0.15, lambda_l2=1.0)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 6)
+
+
+@pytest.mark.slow
+def test_batched_dart_bagging_byte_equal():
+    X, y = _data()
+    base = dict(BASE, boosting="dart", drop_rate=0.5, skip_drop=0.3,
+                bagging_fraction=0.7, bagging_freq=1)
+    grids = [dict(base, learning_rate=0.2, bagging_seed=3),
+             dict(base, learning_rate=0.1, bagging_seed=9, drop_seed=21)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 6)
+
+
+@pytest.mark.slow
+def test_quant_hist_config_byte_equal_under_f64_oracle():
+    # the gate no longer rejects tpu_quant_hist configs; under the f64
+    # oracle (where quant resolves inactive, same as sequential) the
+    # fleet must stay byte-equal — the PR-14 oracle discipline
+    X, y = _data()
+    base = dict(BASE, tpu_quant_hist="on", data_random_seed=13)
+    grids = [dict(base, learning_rate=lr) for lr in (0.1, 0.2, 0.05)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert _texts(fleet) == _seq_texts(grids, X, y, 6)
+
+
+@pytest.mark.slow
+def test_quant_hist_active_stream_parity():
+    # with quantization ACTIVE (f32 path) bitwise equality across
+    # different XLA programs is out of contract, but the per-tree
+    # stochastic-rounding keys must match the sequential host counter:
+    # early trees come out identical and the full models agree to f32
+    # round-off in predictions
+    X, y = _data()
+    base = {k: v for k, v in BASE.items() if k != "tpu_use_f64_hist"}
+    base.update(tpu_quant_hist="on", data_random_seed=13)
+    grids = [dict(base, learning_rate=lr) for lr in (0.1, 0.2)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=3)
+    for p, got in zip(grids, fleet):
+        ref = lgb.train(dict(p), lgb.Dataset(X, label=y),
+                        num_boost_round=3)
+        # tree 0 shares one quantization key between both paths: a qseq
+        # stream mismatch would already diverge here
+        assert got.model_to_string().split("Tree=")[1] \
+            == ref.model_to_string().split("Tree=")[1]
+        np.testing.assert_allclose(got.predict(X), ref.predict(X),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_variant_fleet_2_reuses_trace():
+    # learning rates past the warm-up ramp so fleet #1 traces BOTH the
+    # round program and the GOSS select program; fleet #2 at the same
+    # grid must reuse every trace
+    X, y = _data(seed=3, n=300, f=8)
+    base = dict(BASE, boosting="goss", top_rate=0.3, other_rate=0.2)
+    grids = [dict(base, learning_rate=lr) for lr in (0.5, 0.25)]
+    train_many(grids, lgb.Dataset(X, label=y), num_boost_round=5)
+    before = compile_cache.trace_count()
+    grids2 = [dict(base, learning_rate=lr) for lr in (1.0, 0.4)]
+    train_many(grids2, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert compile_cache.trace_count() - before == 0
+
+
+# ----------------------------------------------------------------------
+# gate: per-member validation + remaining rejections
+# ----------------------------------------------------------------------
+
+def test_gate_validates_every_member_not_just_member_0():
+    # regression (ISSUE 18 satellite): a fleet where only member 1
+    # diverges used to slip past the member-0-only checks
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=0.1),
+             dict(BASE, learning_rate=0.2)]
+    gbdts, cfgs = _probes(grids, X, y)
+    assert batched_gate(gbdts, cfgs) is None
+    # poison member 1 only: a host-side objective gradient override
+    gbdts[1].objective.get_gradients = lambda score: (None, None)
+    reason = batched_gate(gbdts, cfgs)
+    assert reason is not None and reason.startswith("model 1:")
+
+
+def test_gate_admits_goss_dart_quant():
+    X, y = _data(n=200, f=6)
+    for extra in ({"boosting": "goss"}, {"boosting": "dart"},
+                  {"tpu_quant_hist": "on", "tpu_use_f64_hist": False}):
+        grids = [dict(BASE, learning_rate=lr, **extra)
+                 for lr in (0.1, 0.2)]
+        gbdts, cfgs = _probes(grids, X, y)
+        assert batched_gate(gbdts, cfgs) is None, extra
+
+
+def test_gate_remaining_rejection_reasons():
+    X, y = _data(n=200, f=6)
+    # RF reshapes scores host-side per round: still interleaved-only
+    rf = [dict(BASE, boosting="rf", bagging_fraction=0.7, bagging_freq=1,
+               learning_rate=lr) for lr in (0.1, 0.2)]
+    gbdts, cfgs = _probes(rf, X, y)
+    reason = batched_gate(gbdts, cfgs)
+    assert reason is not None and "rf" in reason.lower()
+    # mixed boosting types inside one shape bucket
+    mixed = [dict(BASE, learning_rate=0.1),
+             dict(BASE, learning_rate=0.1, boosting="goss")]
+    gbdts, cfgs = _probes(mixed, X, y)
+    reason = batched_gate(gbdts, cfgs)
+    assert reason is not None
+
+
+# ----------------------------------------------------------------------
+# sub-fleet planning
+# ----------------------------------------------------------------------
+
+def test_chunk_sizes_pow2_greedy():
+    assert _chunk_sizes(128, 48) == [32, 32, 32, 32]
+    assert _chunk_sizes(100, 48) == [32, 32, 36]
+    assert _chunk_sizes(10, 16) == [10]
+    assert _chunk_sizes(5, 2) == [2, 2, 1]
+    assert _chunk_sizes(5, 1) == [1, 1, 1, 1, 1]
+
+
+def test_plan_subfleets_deterministic_and_shape_bucketed():
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=0.1, num_leaves=7),
+             dict(BASE, learning_rate=0.2, num_leaves=15),
+             dict(BASE, learning_rate=0.3, num_leaves=7),
+             dict(BASE, learning_rate=0.1, num_leaves=15)]
+    gbdts, cfgs = _probes(grids, X, y)
+    plans = plan_subfleets(gbdts, cfgs)
+    assert [p.indices for p in plans] == [(0, 2), (1, 3)]
+    assert all(p.reason == "shape" for p in plans)
+    assert plans == plan_subfleets(gbdts, cfgs)   # pure function
+
+
+def test_plan_subfleets_max_fleet_cap():
+    X, y = _data(n=200, f=6)
+    grids = [dict(BASE, learning_rate=0.1 + 0.01 * i,
+                  tpu_sweep_max_fleet=2) for i in range(5)]
+    gbdts, cfgs = _probes(grids, X, y)
+    plans = plan_subfleets(gbdts, cfgs)
+    assert [p.indices for p in plans] == [(0, 1), (2, 3), (4,)]
+    assert all(p.reason == "cap" for p in plans)
+
+
+def test_plan_subfleets_hbm_budget_chunks():
+    X, y = _data(n=256, f=6)
+    # per-model estimate: 1 * 256 * 4 * 2.0 = 2048 B; a 1 MiB budget
+    # holds 512 models — drop it via the knob so 4 models need 2 chunks
+    grids = [dict(BASE, learning_rate=0.1 + 0.01 * i) for i in range(4)]
+    gbdts, cfgs = _probes(grids, X, y)
+    plans = plan_subfleets(gbdts, cfgs)
+    assert len(plans) == 1 and plans[0].reason == "single"
+    # a knob budget below 4x the per-model bytes must split the fleet:
+    # per-model estimate is K * N * 4 * headroom = 1 * 256 * 4 * 2.0
+    from lightgbm_tpu.sweep.subfleet import _budget_bytes, _model_bytes
+    assert _model_bytes(gbdts[0]) == 2048
+    budget, source = _budget_bytes(cfgs[0])
+    assert source == "none" and budget is None  # CPU: no stats, no knob
+    for cfg in cfgs:
+        cfg.tpu_sweep_hbm_budget_mb = 1
+    budget, source = _budget_bytes(cfgs[0])
+    assert source == "knob" and budget == 1 << 20
+
+
+@pytest.mark.slow
+def test_chunked_fleet_byte_equal():
+    # force pow2 chunking of a homogeneous M=3 fleet ([2, 1] — the M=1
+    # chunk rides the ghost lane of the M=2 program) and require the
+    # chunked batched run to still match sequential exactly
+    X, y = _data()
+    grids = [dict(BASE, learning_rate=0.05 + 0.05 * i,
+                  tpu_sweep_max_fleet=2, tpu_sweep_mode="batched")
+             for i in range(3)]
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=5)
+    ref = [dict(BASE, learning_rate=0.05 + 0.05 * i) for i in range(3)]
+    assert _texts(fleet) == _seq_texts(ref, X, y, 5)
+
+
+@pytest.mark.slow
+def test_m128_mixed_shape_fleet_trains_via_subfleets():
+    # M in the hundreds: a mixed-shape 128-model fleet must plan into
+    # shape-bucketed sub-fleets and train end to end on the emulated
+    # device without OOM — two shape buckets of 64, each one batched
+    # program (compile cost is per bucket, not per model)
+    X, y = _data(n=600, f=8)
+    shapes = (7, 15)
+    grids = [dict(BASE, num_leaves=shapes[m % 2],
+                  learning_rate=round(0.05 + 0.2 * m / 128, 5),
+                  tpu_sweep_mode="batched")
+             for m in range(128)]
+    gbdts, cfgs = _probes(grids, X, y)
+    plans = plan_subfleets(gbdts, cfgs)
+    assert [len(p.indices) for p in plans] == [64, 64]
+    assert {cfgs[p.indices[0]].num_leaves for p in plans} == set(shapes)
+    fleet = train_many(grids, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert len(fleet) == 128
+    for m, bst in enumerate(fleet):
+        assert bst.num_trees() == 2
+        assert bst._cfg.num_leaves == shapes[m % 2]
+
+
+# ----------------------------------------------------------------------
+# refresh trigger
+# ----------------------------------------------------------------------
+
+def test_refresh_trigger_edge_behavior():
+    trig = RefreshTrigger(["m0", "m1", "m2"], threshold=0.5)
+    assert trig.observe({"m0": 0.1, "m1": 0.7}) == [1]
+    # already-due members don't re-trigger; unknown models ignored
+    assert trig.observe({"m1": 0.9, "m2": 0.6, "zz": 1.0}) == [2]
+    assert trig.due() == [1, 2]
+    assert trig.drain() == [1, 2]
+    assert trig.due() == []
+    # drained members re-arm
+    assert trig.observe({"m1": 0.8}) == [1]
+
+
+def test_refresh_trigger_poll_from_tracer():
+    class FakeTracer:
+        def burn_rates(self):
+            return {"m0": 0.75, "m1": 0.2}
+    trig = RefreshTrigger(["m0", "m1"])   # default SLO_BURN_HIGH = 0.5
+    assert trig.poll(FakeTracer()) == [0]
+    assert trig.due() == [0]

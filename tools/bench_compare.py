@@ -78,6 +78,18 @@ DIRECTION: Dict[str, int] = {
     "sweep_models_per_s_m32": +1,
     "sweep_speedup_m8": +1,          # batched vs M sequential runs
     "sweep_speedup_m32": +1,
+    # variant fleets (ISSUE 18): batched vs their old interleaved path
+    "sweep_models_per_s_goss_m4": +1,
+    "sweep_models_per_s_goss_m8": +1,
+    "sweep_models_per_s_dart_m4": +1,
+    "sweep_models_per_s_dart_m8": +1,
+    "sweep_speedup_goss_m4": +1,
+    "sweep_speedup_goss_m8": +1,
+    "sweep_speedup_dart_m4": +1,
+    "sweep_speedup_dart_m8": +1,
+    # mixed-shape fleet via shape-bucketed sub-fleets
+    "sweep_models_per_s_hetero_m12": +1,
+    "sweep_models_per_s_hetero_m128": +1,
     "auc": +1,
     "auc_ours_1m_100it": +1,
     "ndcg10": +1,
@@ -109,6 +121,14 @@ METRIC_STAGE = {
     "auc_ours_1m_100it": "ref_parity",
     "sweep_models_per_s_m8": "sweep", "sweep_speedup_m8": "sweep",
     "sweep_models_per_s_m32": "sweep", "sweep_speedup_m32": "sweep",
+    "sweep_models_per_s_goss_m4": "sweep",
+    "sweep_models_per_s_goss_m8": "sweep",
+    "sweep_models_per_s_dart_m4": "sweep",
+    "sweep_models_per_s_dart_m8": "sweep",
+    "sweep_speedup_goss_m4": "sweep", "sweep_speedup_goss_m8": "sweep",
+    "sweep_speedup_dart_m4": "sweep", "sweep_speedup_dart_m8": "sweep",
+    "sweep_models_per_s_hetero_m12": "sweep",
+    "sweep_models_per_s_hetero_m128": "sweep",
     "coldstart_cold_s": "coldstart", "coldstart_aot_s": "coldstart",
     "coldstart_speedup": "coldstart",
     "serve_hbm_per_model_mb_f32": "coldstart",
