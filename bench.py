@@ -194,8 +194,14 @@ def _stage_done(name, out):
     else:
         emit(out)
     if _LEDGER is not None:
+        # t0/t1 on the shared perf_counter clock: the timeline merger
+        # (obs/timeline.py) places the bench lane span from these
+        t_now = time.perf_counter()
         _LEDGER.commit({"kind": "note", "stage": name,
-                        "t_s": round(time.perf_counter() - _T0, 1)})
+                        "t_s": round(t_now - _T0, 1),
+                        "t0": round(t_now - wall, 6),
+                        "t1": round(t_now, 6),
+                        "wall_s": round(wall, 3)})
 
 
 def budget_left():
@@ -736,6 +742,22 @@ def multichip_child() -> None:
         "per_iter_ms": round(per_iter_ms, 2),
         "hbm_claimed_mb": per_dev,
     }
+    if ndev > 1:
+        # one extra round, drained shard-by-shard: per-device wait
+        # attribution of a dist round (obs/profiler.py wait-tiling) —
+        # informational skew data for bench_compare, never the timing
+        # loop itself (per_iter_ms above is already committed)
+        from lightgbm_tpu.obs.profiler import _per_device_segments
+        from lightgbm_tpu.obs.straggler import imbalance_ratio
+        t_att = time.perf_counter()
+        bst.update()
+        segs = _per_device_segments(g.train_score.score, t_att)
+        if segs:
+            rec["device_ids"] = [d for d, _ in segs]
+            rec["device_round_ms"] = [round(w, 3) for _, w in segs]
+            ratio = imbalance_ratio([w for _, w in segs])
+            if ratio is not None:
+                rec["device_imbalance"] = round(ratio, 3)
     h = getattr(ds, "_handle", None) or ds
     st = getattr(h, "_ingest_stats", None)
     if st and st.get("sharded"):
@@ -826,6 +848,8 @@ def run_multichip(out):
     if "ingest_s" in widest:
         out["mc_ingest_s"] = widest["ingest_s"]
         out["mc_ingest_overlap"] = widest["overlap_eff"]
+    if "device_imbalance" in widest:
+        out["mc_device_imbalance"] = widest["device_imbalance"]
     return out
 
 
@@ -1139,7 +1163,8 @@ def main() -> None:
     # and the stage reached (round-5's rc=124/parsed:null failure mode)
     out = {"metric": "higgs_synth_500iter_s", "value": None, "unit": "s"}
     _REC = BenchRecorder(out, path=os.environ.get("BENCH_OUT",
-                                                  "BENCH_partial.json"))
+                                                  "BENCH_partial.json"),
+                         gate=_GATE)
     if os.environ.get("BENCH_TRACE") == "1":
         from lightgbm_tpu.obs import ledger as obs_ledger
         from lightgbm_tpu.obs import trace as obs_trace
@@ -1444,6 +1469,19 @@ def main() -> None:
     _REC.finalize()
     if _LEDGER is not None:
         _LEDGER.close()
+    if os.environ.get("BENCH_TRACE") == "1":
+        # merge every stream this run produced (spans, ledgers, events,
+        # the bench stage notes) into the Perfetto-openable timeline,
+        # next to trace_summary.json — same artifact the CLI writes
+        try:
+            from lightgbm_tpu.obs import timeline as obs_timeline
+            tdir = os.environ.get("BENCH_TRACE_DIR", "lgbt_trace")
+            doc = obs_timeline.build_timeline(tdir, bench=out)
+            path = obs_timeline.write_timeline(
+                os.path.join(tdir, "timeline.json"), doc)
+            log(f"# timeline: {path}")
+        except Exception as e:  # the record on stdout already landed
+            log(f"# timeline export FAILED: {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

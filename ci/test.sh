@@ -1115,6 +1115,108 @@ else
     rm -rf "$(dirname "$OOC_DIR")"
 fi
 
+echo "== timeline smoke (per-device lanes + export CLI + forced anomaly) =="
+TL_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_timeline"
+mkdir -p "$TL_DIR"
+python - <<EOF
+import numpy as np
+rng = np.random.RandomState(17)
+X = rng.rand(1200, 8).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(1200) > 0.5).astype(np.float32)
+np.savetxt("$TL_DIR/train.tsv",
+           np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+EOF
+# clean 4-shard profiled run: rounds 2 and 4 are fenced per device, the
+# CLI auto-writes timeline.json next to trace_summary.json. Two sampled
+# rounds < tpu_straggler_rounds=3, so dist_straggler cannot fire here.
+XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+    python -m lightgbm_tpu task=train "data=$TL_DIR/train.tsv" \
+    objective=binary num_leaves=15 num_iterations=6 verbosity=-1 \
+    tree_learner=data num_machines=4 \
+    tpu_profile=on tpu_profile_every=2 \
+    tpu_trace=true "tpu_trace_dir=$TL_DIR/trace" \
+    "output_model=$TL_DIR/model.txt" > "$TL_DIR/train.log" 2>&1
+grep -q "run timeline at" "$TL_DIR/train.log" || {
+    echo "FAIL: CLI did not announce the timeline artifact" >&2
+    tail -5 "$TL_DIR/train.log" >&2; exit 1; }
+# the export tool must re-produce it from the same artifacts: exit 0
+python tools/timeline_export.py --trace-dir "$TL_DIR/trace" \
+    --out "$TL_DIR/export.json" 2> "$TL_DIR/export.log"
+TL_SMOKE_DIR="$TL_DIR" python - <<'EOF'
+import glob
+import json
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+
+d = os.environ["TL_SMOKE_DIR"]
+tdir = os.path.join(d, "trace")
+doc = json.load(open(os.path.join(tdir, "timeline.json")))
+evs = doc["traceEvents"]
+assert evs and all("ph" in e and "pid" in e for e in evs), evs[:3]
+other = doc["otherData"]
+assert other["schema"] == 1, other
+# 4 emulated devices -> 4 per-device lanes under the train pid
+assert other["device_lanes"] >= 4, other["device_lanes"]
+srcs = {e.get("args", {}).get("src") for e in evs
+        if e.get("ph") in ("X", "i")}
+assert {"spans", "ledger", "ledger.device", "events"} <= srcs, srcs
+# profiled dist rounds carry per-device terms; clean run has no
+# straggler / anomaly notes
+paths = sorted(glob.glob(os.path.join(tdir, "ledger-*.jsonl")))
+recs = obs_ledger.read_ledger(paths[-1])
+prof = [r for r in recs if r.get("kind") == "round" and r.get("profiled")]
+assert prof, "no profiled rounds in ledger"
+for r in prof:
+    assert len(r["device_ids"]) == 4, r
+    assert set(r["device_terms_ms"]) == set(r["terms_ms"]), r
+    assert r["imbalance"] >= 1.0 and "allreduce_split_ms" in r, r
+notes = {r.get("note") for r in recs if r.get("kind") == "note"}
+assert "round_anomaly" not in notes and "dist_straggler" not in notes, notes
+exp = json.load(open(os.path.join(d, "export.json")))
+assert len(exp["traceEvents"]) == len(evs), (len(exp["traceEvents"]),
+                                             len(evs))
+print(f"timeline smoke: ok ({len(evs)} trace events, "
+      f"{other['device_lanes']} device lanes, "
+      f"{len(prof)} profiled rounds with per-device terms)")
+EOF
+# forced anomaly: factor 0.5 makes any round slower than half the
+# rolling median "anomalous", so once the 3-round baseline exists the
+# watch must fire — pure host arithmetic, deterministic on CPU
+python -m lightgbm_tpu task=train "data=$TL_DIR/train.tsv" \
+    objective=binary num_leaves=15 num_iterations=10 verbosity=-1 \
+    tpu_anomaly_factor=0.5 tpu_anomaly_window=4 \
+    tpu_trace=true "tpu_trace_dir=$TL_DIR/trace_anom" \
+    "output_model=$TL_DIR/model_anom.txt" > "$TL_DIR/anom.log" 2>&1
+TL_SMOKE_DIR="$TL_DIR" python - <<'EOF'
+import glob
+import json
+import os
+
+from lightgbm_tpu.obs import ledger as obs_ledger
+
+d = os.environ["TL_SMOKE_DIR"]
+tdir = os.path.join(d, "trace_anom")
+paths = sorted(glob.glob(os.path.join(tdir, "ledger-*.jsonl")))
+recs = obs_ledger.read_ledger(paths[-1])
+anom = [r for r in recs if r.get("kind") == "note"
+        and r.get("note") == "round_anomaly"]
+assert anom, "forced anomaly watch did not fire"
+a = anom[0]
+assert a["ratio"] > 0 and a["median_ms"] > 0 and "round" in a, a
+doc = json.load(open(os.path.join(tdir, "timeline.json")))
+marks = [e for e in doc["traceEvents"] if e.get("ph") == "i"
+         and e.get("name") == "round_anomaly"]
+assert marks, "round_anomaly instant missing from timeline"
+print(f"anomaly smoke: ok (round {a['round']} flagged at "
+      f"{a['ratio']}x median {a['median_ms']}ms, instant on timeline)")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "timeline artifacts kept under $TL_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$TL_DIR")"
+fi
+
 echo "== graftlint (invariant gate) =="
 # the real tree must be clean: exit 0, no new findings
 python -m tools.lint

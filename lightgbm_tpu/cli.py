@@ -128,6 +128,14 @@ class Application:
 
     def train(self) -> int:
         cfg = self.config
+        if cfg.tpu_trace:
+            # enable the file-backed tracer BEFORE data load: ingest
+            # fires its events (stream_ingest / dist_stream / dist_init)
+            # during dataset construction, and the timeline's events tee
+            # only captures what happens after the trace dir exists
+            # (GBDT.__init__'s own enable() call is an idempotent no-op)
+            from .obs import trace as obs_trace
+            obs_trace.enable(cfg.tpu_trace_dir or "lgbt_trace")
         train_set, valid_sets, valid_names = self._load_train_data()
         if cfg.is_provide_training_metric:
             valid_sets = [train_set] + valid_sets
@@ -178,6 +186,13 @@ class Application:
             dump = obs_trace.write(
                 os.path.join(tdir, "trace_summary.json"), extra=extra)
             print(f"Telemetry: span summary at {dump}")
+            from .obs import timeline as obs_timeline
+            if obs_timeline.timeline_on(cfg):
+                tl = obs_timeline.build_timeline(tdir)
+                tpath = obs_timeline.write_timeline(
+                    os.path.join(tdir, "timeline.json"), tl)
+                print(f"Telemetry: run timeline at {tpath} "
+                      f"(open in Perfetto / chrome://tracing)")
         if getattr(booster, "_preempted", False):
             from .resilience import EXIT_PREEMPTED
             print(f"Preempted mid-training; checkpoint flushed. "

@@ -710,6 +710,35 @@ class Config:
     # jax.profiler trace; artifact directory paths land in
     # trace_summary.json. Empty disables capture
     tpu_profile_capture: str = ""
+    # unified run timeline (obs/timeline.py): "auto" (default — live
+    # exactly when tpu_trace is), "on", or "off". Live, the CLI and
+    # bench write a Chrome-trace/Perfetto timeline.json next to
+    # trace_summary.json joining every JSONL/event stream on one
+    # monotonic clock, the round loop runs the zero-fence rolling-
+    # median anomaly watch (round_anomaly ledger notes + events), and
+    # profiler-sampled rounds of distributed runs fence per shard —
+    # per-device terms_ms columns, imbalance ratio, and the
+    # edge-triggered dist_straggler / sweep_subfleet_imbalance
+    # watches. Off adds zero fences and zero work. Runtime-only:
+    # excluded from model text and checkpoint signatures
+    tpu_timeline: str = "auto"
+    # imbalance ratio (max/median per-device or per-sub-fleet round
+    # time) at or above which the straggler watch counts a sampled
+    # round as imbalanced. Runtime-only, like tpu_timeline
+    tpu_straggler_threshold: float = 1.5
+    # consecutive imbalanced sampled rounds before the edge-triggered
+    # straggler event fires (and consecutive calm rounds below the
+    # hysteresis clear level before it clears). Runtime-only
+    tpu_straggler_rounds: int = 3
+    # anomaly factor N for the in-run round-wall watch: a traced
+    # round's wall > N x the trailing-window median commits a
+    # round_anomaly ledger note + event (pure host arithmetic, zero
+    # fences). 0 disables the watch. Runtime-only
+    tpu_anomaly_factor: float = 3.0
+    # trailing window length in rounds for the anomaly median;
+    # anomalous rounds never enter the window. The watch arms after
+    # window/4 (at least 3) normal rounds. Runtime-only
+    tpu_anomaly_window: int = 32
     # many-model sweep trainer (sweep/train_many): "auto" partitions
     # the fleet into shape-bucketed sub-fleets (sweep/subfleet.py) and
     # batches each into one vmapped round program — GBDT, GOSS, and
@@ -827,6 +856,11 @@ class Config:
             raise ValueError(
                 f"tpu_serve_compact must be off/f16/int8, got "
                 f"{self.tpu_serve_compact!r}")
+        self.tpu_timeline = self.tpu_timeline.strip().lower()
+        if self.tpu_timeline not in ("off", "on", "auto"):
+            raise ValueError(
+                f"tpu_timeline must be off/on/auto, got "
+                f"{self.tpu_timeline!r}")
 
     def _check_conflicts(self) -> None:
         """Parameter-conflict resolution (reference `CheckParamConflict`

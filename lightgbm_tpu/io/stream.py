@@ -495,17 +495,22 @@ def finish_sharded_ingest(ds: Dataset, appender: ShardedAppender,
         "overlap_eff": overlap_eff,
         "pipeline_depth": int(depth),
     }
+    # ingest started wall_s before now — the timeline merger places the
+    # ingest lane span at t_start on the shared perf_counter clock
+    t_ingest = round(time.perf_counter() - wall_s, 6)
     log.event("stream_ingest", rows=int(appender.n),
               chunk_rows=int(chunk_rows),
               device_cols=ds._ingest_stats["device_cols"],
               host_cols=ds._ingest_stats["host_cols"],
-              ingest_ms=ms, source=source)
+              ingest_ms=ms, wall_ms=round(wall_s * 1e3, 1),
+              t_start=t_ingest, source=source)
     log.event("dist_stream", rows=int(appender.n),
               shards=int(appender.nd),
               per_shard=int(appender.per_shard),
               chunk_rows=int(chunk_rows),
               parse_ms=ds._ingest_stats["parse_ms"],
               bin_ms=ds._ingest_stats["bin_ms"],
+              wall_ms=round(wall_s * 1e3, 1), t_start=t_ingest,
               ingest_ms=round(ms, 1), overlap_eff=overlap_eff,
               pipeline_depth=int(depth),
               bytes_per_device=int(shard_bytes),
@@ -622,7 +627,8 @@ def stream_matrix(data, label=None, config: Optional[Config] = None,
     log.event("stream_ingest", rows=int(n), chunk_rows=int(chunk_rows),
               device_cols=ds._ingest_stats["device_cols"],
               host_cols=ds._ingest_stats["host_cols"],
-              ingest_ms=ms, source="matrix")
+              ingest_ms=ms, wall_ms=round(ms, 1),
+              t_start=round(t0, 6), source="matrix")
     return ds
 
 

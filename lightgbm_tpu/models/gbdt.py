@@ -16,7 +16,7 @@ once per eval.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -277,6 +277,25 @@ class GBDT:
             if self._profiler is not None:
                 from .. import compile_cache
                 compile_cache.enable_arg_capture()
+        # unified timeline + watches (obs/timeline.py, obs/straggler.py):
+        # off, the round loop pays one bool check and zero fences. On,
+        # traced rounds feed the rolling-median anomaly watch (pure
+        # host arithmetic over walls the trace fence already measured)
+        # and profiler-sampled rounds on a multi-device mesh attribute
+        # their fenced drains per shard for the straggler watch
+        self._timeline = cfg.tpu_timeline == "on" or (
+            cfg.tpu_timeline == "auto" and cfg.tpu_trace)
+        self._anomaly = None
+        self._straggler = None
+        if self._timeline:
+            from ..obs.straggler import AnomalyWatch, ImbalanceWatch
+            if cfg.tpu_anomaly_factor > 0:
+                self._anomaly = AnomalyWatch(
+                    factor=cfg.tpu_anomaly_factor,
+                    window=cfg.tpu_anomaly_window)
+            self._straggler = ImbalanceWatch(
+                threshold=cfg.tpu_straggler_threshold,
+                rounds=cfg.tpu_straggler_rounds)
 
     @staticmethod
     def _reshape_init_score(ds: Dataset) -> Optional[np.ndarray]:
@@ -503,6 +522,10 @@ class GBDT:
             "trees": len(self.models),
             "bag_cnt": int(self.bag_data_cnt),
             "finished": bool(finished),
+            # raw perf_counter at round start: the timeline's clock
+            # anchor (CLOCK_MONOTONIC — shared across processes on the
+            # host, so spans/ledger/reqtrace join without alignment)
+            "t0": round(t0, 6),
         }
         self._obs_fallbacks_seen = fb
         notes = list(getattr(self, "_gate_notes", ()) or ())
@@ -510,10 +533,62 @@ class GBDT:
             rec["gate_notes"] = notes
             rec["hist_spill"] = any("spill" in n.lower() for n in notes)
         self.telemetry.commit(rec)
+        if self._anomaly is not None:
+            # residual-mode walls only: fenced (profiled) rounds
+            # serialize the pipeline and would poison the median
+            self._note_anomaly(rnd, rec["wall_ms"])
         if self._metrics is not None:
             self._note_round_metrics(rec["wall_ms"], rec["traces"],
                                      rec["fallbacks"])
         return finished
+
+    def _note_anomaly(self, rnd: int, wall_ms: float) -> None:
+        """Fold one traced round's wall into the rolling-median anomaly
+        watch (obs/straggler.py — pure host arithmetic, zero fences). A
+        deviation past tpu_anomaly_factor commits a ``round_anomaly``
+        ledger note + event while the run can still react — a bench
+        about to blow its budget says WHERE before the driver's kill."""
+        hit = self._anomaly.update(wall_ms)
+        if hit is None:
+            return
+        import time as _time
+
+        from ..utils import log
+        if self.telemetry is not None:
+            self.telemetry.commit(
+                {"kind": "note", "note": "round_anomaly", "round": rnd,
+                 "wall_ms": round(wall_ms, 3),
+                 "t0": round(_time.perf_counter(), 6), **hit})
+        log.event("round_anomaly", round=rnd,
+                  wall_ms=round(wall_ms, 3), **hit)
+
+    def _note_straggler(self, rnd: int, dev: Dict[str, Any]) -> None:
+        """Feed one profiled round's per-device imbalance ratio into
+        the gauge + the edge-triggered straggler watch; a raise/clear
+        transition commits a ``dist_straggler`` ledger note + event."""
+        ratio = dev.get("imbalance")
+        if ratio is None:
+            return
+        from ..obs import metrics as obs_metrics
+        if obs_metrics.enabled():
+            obs_metrics.registry().gauge(
+                "dist_device_imbalance",
+                "max/median per-device round time on the last "
+                "profiled distributed round").set(float(ratio))
+        edge = self._straggler.update(ratio)
+        if edge is None:
+            return
+        import time as _time
+
+        from ..utils import log
+        if self.telemetry is not None:
+            self.telemetry.commit(
+                {"kind": "note", "note": "dist_straggler", "round": rnd,
+                 "state": edge, "imbalance": ratio,
+                 "t0": round(_time.perf_counter(), 6)})
+        log.event("dist_straggler", round=rnd, state=edge,
+                  imbalance=ratio,
+                  devices=len(dev.get("device_ids", ())))
 
     def _train_one_iter_profiled(self, prof, grad, hess) -> bool:
         """One profiler-sampled round: drain the pipelined backlog, then
@@ -532,7 +607,11 @@ class GBDT:
         # drain queued work from previous (pipelined) rounds BEFORE t0
         # so the first fenced site doesn't absorb the backlog
         obs_trace.force_fence(self._round_fence_target())
-        sample = prof.begin_round(rnd)
+        per_dev = False
+        if self._timeline:
+            mesh = getattr(self.learner, "mesh", None)
+            per_dev = mesh is not None and int(mesh.devices.size) >= 2
+        sample = prof.begin_round(rnd, per_device=per_dev)
         self._prof_round = sample
         traces0 = trace_count()
         t0 = _time.perf_counter()
@@ -571,7 +650,14 @@ class GBDT:
             "profiled": True,
             "timing": "fenced",
             "terms_ms": terms,
+            "t0": round(t0, 6),
         }
+        # per-device attribution (timeline on, multi-device mesh): the
+        # fenced wait-attribution columns, their imbalance ratio, and
+        # the allreduce compute-vs-wait split
+        dev = sample.device_columns(prof.objective) if per_dev else None
+        if dev is not None:
+            rec.update(dev)
         self._obs_fallbacks_seen = fb
         notes = list(getattr(self, "_gate_notes", ()) or ())
         if notes:
@@ -585,6 +671,8 @@ class GBDT:
                     {"kind": "note", "note": "profile_calibration",
                      **prof.calibration})
             self.telemetry.commit(rec)
+        if dev is not None and self._straggler is not None:
+            self._note_straggler(rnd, dev)
         m = self._metrics
         if m is not None:
             # counters advance, but round_ms.observe is deliberately

@@ -40,6 +40,9 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_aot_dir", "tpu_serve_compact", "tpu_serve_compact_tol",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
+    # timeline + straggler/anomaly watches: observability only
+    "tpu_timeline", "tpu_straggler_threshold", "tpu_straggler_rounds",
+    "tpu_anomaly_factor", "tpu_anomaly_window",
     # sweep-trainer infrastructure: the fleet's model bytes must match
     # the sequential twin's regardless of how the sweep was driven
     "tpu_sweep_mode", "tpu_sweep_checkpoint_dir",

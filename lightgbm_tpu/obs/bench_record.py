@@ -121,10 +121,15 @@ class BenchRecorder:
     """Owns the cumulative bench `out` dict and its durability."""
 
     def __init__(self, out: Dict[str, Any], path: Optional[str] = None,
-                 install_traps: bool = True) -> None:
+                 install_traps: bool = True,
+                 gate: Optional[BudgetGate] = None) -> None:
         self.out = out
         self.path = path
         self.finalized = False
+        # the gate shares the run's t0 and owns the per-stage walls, so
+        # the START emit can say how deep into the run the kill landed
+        self.gate = gate
+        self.t0 = gate.t0 if gate is not None else time.perf_counter()
         out.setdefault("incomplete", True)
         out.setdefault("stage_reached", None)
         out.setdefault("stages_done", [])
@@ -138,7 +143,13 @@ class BenchRecorder:
         # end: a run SIGKILLed mid-stage — including during a long
         # C-level XLA compile, where Python signal traps never run —
         # still has a parseable cumulative record as its last stdout
-        # line (plus the stage name on disk)
+        # line (plus the stage name on disk). elapsed_s + the cumulative
+        # stage walls turn that record into "killed N s in, inside
+        # <stage>, after these completed stages cost this much" without
+        # any stderr scraping (tools/bottleneck_report.py reads both).
+        self.out["elapsed_s"] = round(time.perf_counter() - self.t0, 1)
+        if self.gate is not None and self.gate.stage_wall:
+            self.out["stage_wall_s"] = dict(self.gate.stage_wall)
         self.emit()
 
     def stage_done(self, name: str) -> None:

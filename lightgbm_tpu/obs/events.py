@@ -31,6 +31,9 @@ EVENTS: Dict[str, str] = {
     "quant_hist": "quantized-histogram path resolution: active bits "
                   "and payload dtype, or why the f32 oracle ran "
                   "instead",
+    "round_anomaly": "a traced round's wall time deviated past the "
+                     "anomaly factor from the trailing-window median "
+                     "(in-run anomaly watch; edge-triggered)",
     "stream_ingest": "streaming out-of-core ingest finished: rows, "
                      "chunk size, device-vs-host binning split, wall "
                      "time",
@@ -81,6 +84,10 @@ EVENTS: Dict[str, str] = {
     "sweep_subfleet": "one shape-bucketed batched sub-fleet started: "
                       "member indices, size, split reason (shape / hbm "
                       "/ cap), score-stack MiB, variant",
+    "sweep_subfleet_imbalance": "sustained per-sub-fleet round-wall "
+                                "imbalance (max/median) crossed or "
+                                "cleared the straggler threshold "
+                                "(edge-triggered)",
     "sweep_train": "train_many finished: fleet size, mode, rounds, "
                    "wall time, trace count",
     # distributed runtime (dist/)
@@ -90,6 +97,10 @@ EVENTS: Dict[str, str] = {
                    "score buffers back onto the mesh",
     "dist_shard": "dataset sharded across the mesh: rows per shard, "
                   "per-device HBM bytes, bin-sync wall time",
+    "dist_straggler": "sustained per-device round-time imbalance "
+                      "(max/median over fenced per-shard segments) on "
+                      "profiled distributed rounds crossed or cleared "
+                      "the straggler threshold (edge-triggered)",
     "dist_stream": "stream-to-shard ingest finished: rows, mesh width, "
                    "chunk size, parse/bin walls + overlap efficiency of "
                    "the double-buffered pipeline, per-device shard "

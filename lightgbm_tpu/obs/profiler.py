@@ -183,17 +183,66 @@ def write_program_costs(path: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+def _per_device_segments(out: Any, t_start: float
+                         ) -> Optional[List[Tuple[int, float]]]:
+    """Per-shard wait-attribution of one dispatch: find the first
+    multi-shard jax.Array in the output pytree and block its
+    addressable shards one by one in device-id order, charging each
+    device the INCREMENT of wall spent until its shard was ready
+    (the first segment starts at `t_start`, the site's dispatch start,
+    so host dispatch wall lands in the first-ready device's column).
+
+    The increments tile the site's wall — device k's column is
+    "additional wall spent waiting on shard k after shard k-1 was
+    ready", so the columns SUM to the aggregate fenced site time by
+    construction (the straggler shard absorbs the skew; earlier-ready
+    shards read ~0 once the slowest has been paid for). None when the
+    output has no multi-shard array (single-device run, host-only
+    site) — the caller falls back to the aggregate fence."""
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(out)
+        target = None
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                try:
+                    shards = leaf.addressable_shards
+                except Exception:  # noqa: BLE001 — committed-elsewhere
+                    continue
+                if len(shards) > 1:
+                    target = shards
+                    break
+        if target is None:
+            return None
+        segs: List[Tuple[int, float]] = []
+        t_prev = t_start
+        for sh in sorted(target, key=lambda s: s.device.id):
+            trace.force_fence(sh.data)
+            now = time.perf_counter()
+            segs.append((int(sh.device.id), (now - t_prev) * 1e3))
+            t_prev = now
+        return segs
+    except Exception:  # noqa: BLE001 — attribution must not break a round
+        return None
+
+
 class RoundSample:
     """Per-site fenced times of ONE sampled round. ``timed`` is the
     seam ``GBDT._dispatch_device`` (and the gradient / score-update /
-    eval sites) routes through while ``_prof_round`` is set."""
+    eval sites) routes through while ``_prof_round`` is set. With
+    ``per_device`` (profiled distributed rounds under the timeline),
+    each site's drain is additionally attributed per shard — see
+    ``_per_device_segments``."""
 
-    __slots__ = ("round", "sites", "t0")
+    __slots__ = ("round", "sites", "t0", "per_device", "device_sites")
 
-    def __init__(self, rnd: int) -> None:
+    def __init__(self, rnd: int, per_device: bool = False) -> None:
         self.round = rnd
         self.sites: Dict[str, float] = {}
         self.t0 = time.perf_counter()
+        self.per_device = per_device
+        # site -> {device_id: ms} (only sites whose output was sharded)
+        self.device_sites: Dict[str, Dict[int, float]] = {}
 
     def timed(self, site: str, fn: Callable, *args):
         """Run one dispatch, fence its output pytree, and charge the
@@ -201,6 +250,12 @@ class RoundSample:
         valid walk hits score_update once per valid set)."""
         t0 = time.perf_counter()
         out = fn(*args)
+        if self.per_device:
+            segs = _per_device_segments(out, t0)
+            if segs is not None:
+                acc = self.device_sites.setdefault(site, {})
+                for did, ms in segs:
+                    acc[did] = acc.get(did, 0.0) + ms
         trace.force_fence(out)
         self.sites[site] = self.sites.get(site, 0.0) \
             + (time.perf_counter() - t0) * 1e3
@@ -208,6 +263,46 @@ class RoundSample:
 
     def device_total_ms(self) -> float:
         return sum(self.sites.values())
+
+    def device_columns(self, objective: str = ""
+                       ) -> Optional[Dict[str, Any]]:
+        """Fold ``device_sites`` into the ledger's per-device block:
+        ``{device_ids, device_terms_ms, device_round_ms, imbalance,
+        allreduce_split_ms?}`` — or None when no site produced
+        shard-level segments."""
+        if not self.device_sites:
+            return None
+        ids = sorted({did for per in self.device_sites.values()
+                      for did in per})
+        dterms: Dict[str, List[float]] = {}
+        for site, per in self.device_sites.items():
+            term = term_for_site(site, objective)
+            col = dterms.setdefault(term, [0.0] * len(ids))
+            for k, did in enumerate(ids):
+                col[k] += per.get(did, 0.0)
+        dterms = {t: [round(v, 3) for v in col]
+                  for t, col in dterms.items()}
+        totals = [round(sum(col[k] for col in dterms.values()), 3)
+                  for k in range(len(ids))]
+        out: Dict[str, Any] = {"device_ids": ids,
+                               "device_terms_ms": dterms,
+                               "device_round_ms": totals}
+        med = sorted(totals)[len(totals) // 2] if len(totals) % 2 \
+            else sum(sorted(totals)[len(totals) // 2 - 1:
+                                    len(totals) // 2 + 1]) / 2.0
+        if med > 0:
+            out["imbalance"] = round(max(totals) / med, 3)
+        ar = self.device_sites.get("dist.allreduce")
+        if ar:
+            # first-ready shard ~ everyone computing; the rest is the
+            # skew the slow shard made the collective wait for
+            vals = [ar.get(d, 0.0) for d in ids]
+            compute = min(v for v in vals if v > 0) if any(
+                v > 0 for v in vals) else 0.0
+            out["allreduce_split_ms"] = {
+                "compute": round(compute, 3),
+                "wait": round(max(sum(vals) - compute, 0.0), 3)}
+        return out
 
 
 class RoundProfiler:
@@ -277,9 +372,10 @@ class RoundProfiler:
         loop itself stays fence-free)."""
         self._force_next = True
 
-    def begin_round(self, rnd: int) -> RoundSample:
+    def begin_round(self, rnd: int,
+                    per_device: bool = False) -> RoundSample:
         self._force_next = False
-        return RoundSample(rnd)
+        return RoundSample(rnd, per_device=per_device)
 
     def finish_round(self, sample: RoundSample,
                      engine: Any = None,

@@ -110,6 +110,9 @@ class TraceSpan:
             "kind": "request", "trace_id": self.trace_id,
             "model": self.model, "rows": self.rows,
             "ts": round(self.ts, 6),
+            # monotonic submit time: what the unified timeline
+            # (obs/timeline.py) joins on — epoch ts is reporting-only
+            "t_submit": round(self.t_submit, 6),
             "queue_wait_ms": r3(self.queue_wait_ms),
             "batch_id": self.batch_id,
             "flush_reason": self.flush_reason,

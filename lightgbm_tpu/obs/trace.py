@@ -38,6 +38,7 @@ _spans: List[Dict[str, Any]] = []
 _depth = 0
 _lock = threading.Lock()
 _block = None            # resolved lazily to jax.block_until_ready
+_efh = None              # events-<pid>.jsonl tee (timeline join)
 
 
 def enabled() -> bool:
@@ -48,12 +49,15 @@ def enable(trace_dir: Optional[str] = None) -> None:
     """Turn the tracer on, optionally appending span JSONL under
     `trace_dir` (created if missing). Idempotent; a later call with a
     directory upgrades a memory-only tracer to a file-backed one."""
-    global _enabled, _dir, _fh
+    global _enabled, _dir, _fh, _efh
     with _lock:
         _enabled = True
         if trace_dir and trace_dir != _dir:
             if _fh is not None:
                 _fh.close()
+            if _efh is not None:
+                _efh.close()
+                _efh = None
             os.makedirs(trace_dir, exist_ok=True)
             _dir = trace_dir
             _fh = open(os.path.join(trace_dir,
@@ -61,13 +65,40 @@ def enable(trace_dir: Optional[str] = None) -> None:
 
 
 def disable() -> None:
-    global _enabled, _fh, _dir
+    global _enabled, _fh, _dir, _efh
     with _lock:
         _enabled = False
         if _fh is not None:
             _fh.close()
             _fh = None
+        if _efh is not None:
+            _efh.close()
+            _efh = None
         _dir = None
+
+
+def tee_event(kind: str, fields: Dict[str, Any]) -> None:
+    """Mirror one structured event (utils/log.event) into
+    ``<dir>/events-<pid>.jsonl``, stamped with a monotonic ``t0`` so
+    the timeline (obs/timeline.py) can place compile-cache misses,
+    straggler raises, ingest completions etc. on the run's shared
+    clock. No-op unless a file-backed trace directory is configured —
+    the untraced path pays one bool check in utils/log.event and never
+    reaches here."""
+    global _efh
+    if not _enabled or _dir is None:
+        return
+    rec = {"kind": "event", "event": kind, "t0": time.perf_counter()}
+    rec.update(fields)
+    with _lock:
+        if _dir is None:
+            return
+        if _efh is None:
+            _efh = open(os.path.join(_dir,
+                                     f"events-{os.getpid()}.jsonl"),
+                        "a")
+        _efh.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+        _efh.flush()
 
 
 def reset() -> None:

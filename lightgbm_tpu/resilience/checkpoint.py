@@ -59,6 +59,10 @@ RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_aot_dir", "tpu_serve_compact", "tpu_serve_compact_tol",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
+    # timeline + straggler/anomaly watches (obs/timeline.py,
+    # obs/straggler.py): observability of the run, not training math
+    "tpu_timeline", "tpu_straggler_threshold", "tpu_straggler_rounds",
+    "tpu_anomaly_factor", "tpu_anomaly_window",
     # sweep-trainer infrastructure (sweep/): a fleet checkpoint may be
     # resumed with different sweep plumbing, and a sequential checkpoint
     # is mode-independent anyway

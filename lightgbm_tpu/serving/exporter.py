@@ -13,7 +13,12 @@ A stdlib `ThreadingHTTPServer` (no new dependencies) bound to
   bytes saved when a model registry is attached;
 * ``GET /debug/requests`` — the request tracer's live view (recent
   ring, slowest-request table, burn rates) when ``tpu_serve_trace`` is
-  on; ``{"enabled": false}`` otherwise.
+  on; ``{"enabled": false}`` otherwise;
+* ``GET /debug/timeline`` — the unified run timeline (Chrome-trace
+  ``trace_events`` JSON, ``obs/timeline.py``) built live from the
+  attached trace directory; ``{"enabled": false}`` when the process
+  runs without a file-backed trace dir. Save the body to a file and
+  open it in Perfetto / ``chrome://tracing``.
 
 Every scrape refreshes the HBM accountant first (`obs.memory.snapshot`
 reads owner callbacks + backend memory_stats at that moment), so the
@@ -56,6 +61,10 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(self.exporter.render_requests(),
                                   sort_keys=True, default=str).encode()
                 ctype = "application/json"
+            elif path == "/debug/timeline":
+                body = json.dumps(self.exporter.render_timeline(),
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
             elif path in ("/", "/healthz"):
                 body = b"ok\n"
                 ctype = "text/plain"
@@ -79,12 +88,16 @@ class MetricsExporter:
     """HTTP scrape endpoint over the process metrics registry."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 tracer=None, registry=None) -> None:
+                 tracer=None, registry=None,
+                 trace_dir: Optional[str] = None) -> None:
         obs_metrics.enable()
         self.tracer = tracer
         # model registry (serving/registry.py): when attached,
         # /metrics.json carries per-model AOT + compaction detail
         self.registry = registry
+        # trace dir (obs/trace.py file-backed sink): when attached,
+        # /debug/timeline merges its streams live on every GET
+        self.trace_dir = trace_dir
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True
@@ -116,6 +129,15 @@ class MetricsExporter:
             return {"schema": 1, "enabled": False}
         return dict({"schema": 1, "enabled": True},
                     **self.tracer.snapshot())
+
+    def render_timeline(self) -> Dict[str, Any]:
+        """The /debug/timeline document: the merged Chrome-trace JSON
+        built from the attached trace dir at scrape time, so the lanes
+        grow as the run does; {"enabled": false} with no trace dir."""
+        if not self.trace_dir:
+            return {"schema": 1, "enabled": False}
+        from ..obs import timeline as obs_timeline
+        return obs_timeline.build_timeline(self.trace_dir)
 
     @property
     def url(self) -> str:

@@ -69,9 +69,16 @@ class ServingService:
             tracer=self.tracer)
         if cfg.tpu_serve_metrics_port:
             from .exporter import MetricsExporter
+            # /debug/timeline merges whatever file-backed trace streams
+            # this process has: the live obs.trace dir when training ran
+            # here, else the request tracer's out_dir
+            from ..obs import trace as obs_trace
+            tdir = obs_trace.trace_dir() if obs_trace.enabled() else None
+            tdir = tdir or cfg.tpu_serve_trace_dir or None
             self.exporter = MetricsExporter(cfg.tpu_serve_metrics_port,
                                             tracer=self.tracer,
-                                            registry=self.registry)
+                                            registry=self.registry,
+                                            trace_dir=tdir)
         self._watchers: Dict[str, CheckpointWatcher] = {}
         self._closed = False
 

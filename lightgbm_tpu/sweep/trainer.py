@@ -565,6 +565,7 @@ class _BatchedRun:
         for m, g in enumerate(self.gbdts):
             rec = {"kind": "round", "round": rnd_iters[m],
                    "wall_ms": wall, "device_ms": dev,
+                   "t0": round(t0, 6), "subfleet": self.sid,
                    "traces": traces_delta if m == 0 else 0,
                    "path": "sweep", "aligned": False, "fallbacks": 0,
                    "trees": len(g.models), "model": self.idx[m],
@@ -617,12 +618,25 @@ def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
     for run in runs:
         run.start()
 
+    watch = None
+    if len(runs) >= 2:
+        from ..obs.straggler import ImbalanceWatch
+        from ..obs.timeline import timeline_on
+        if timeline_on(cfg0):
+            watch = ImbalanceWatch(
+                threshold=float(cfg0.tpu_straggler_threshold),
+                rounds=int(cfg0.tpu_straggler_rounds))
     ckpt_freq = int(cfg0.tpu_sweep_checkpoint_freq or 0)
     for r in range(start_round, num_boost_round):
         # interleaved dispatch across sub-fleets: run #2's host schedule
         # overlaps run #1's device round (async dispatch)
+        walls = []
         for run in runs:
+            t_step = time.perf_counter()
             run.step(r)
+            walls.append((time.perf_counter() - t_step) * 1e3)
+        if watch is not None:
+            _watch_subfleets(watch, walls, r, len(runs), ledger)
         if ckpt_freq > 0 and cfg0.tpu_sweep_checkpoint_dir \
                 and (r + 1) % ckpt_freq == 0:
             _write_batched_ckpt(cfg0.tpu_sweep_checkpoint_dir, r + 1,
@@ -640,6 +654,34 @@ def _train_batched(probes, gbdts, cfgs, clean_params, num_boost_round,
         bst._sweep_scores_bytes = scores_nbytes
         out.append(bst)
     return out
+
+
+def _watch_subfleets(watch, walls, r, n_runs, ledger) -> None:
+    """Per-round sub-fleet imbalance: step walls are mostly host
+    schedule time under async dispatch, but a sub-fleet whose dispatch
+    queue backs up (HBM pressure, recompiles) shows up here without
+    adding a single fence. Edge-triggered like the dist straggler."""
+    from ..obs import metrics as obs_metrics
+    from ..obs.straggler import imbalance_ratio
+    ratio = imbalance_ratio(walls)
+    if ratio is None:
+        return
+    if obs_metrics.enabled():
+        obs_metrics.registry().gauge(
+            "sweep_subfleet_imbalance",
+            "max/median sub-fleet round-step wall ratio").set(ratio)
+    edge = watch.update(ratio)
+    if edge is None:
+        return
+    slowest = int(max(range(len(walls)), key=walls.__getitem__))
+    if ledger is not None:
+        ledger.commit({"kind": "note", "note": "sweep_subfleet_imbalance",
+                       "round": r, "state": edge,
+                       "imbalance": round(ratio, 3), "subfleet": slowest,
+                       "t0": round(time.perf_counter(), 6)})
+    log.event("sweep_subfleet_imbalance", round=r, state=edge,
+              imbalance=round(ratio, 3), subfleet=slowest,
+              subfleets=n_runs)
 
 
 def _materialize_fleet(gbdts, rec_log) -> List[List[Any]]:

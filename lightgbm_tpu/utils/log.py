@@ -86,6 +86,26 @@ def _check_kind(kind: str) -> None:
     assert why is None, why
 
 
+_tee = None   # resolved lazily to obs.trace (False when unimportable)
+
+
+def _maybe_tee(kind: str, fields: Dict[str, Any]) -> None:
+    """Mirror the event into the span tracer's events-<pid>.jsonl when
+    a file-backed trace is live, stamping a monotonic t0 — the
+    timeline's join channel for otherwise-clockless events. Runs BEFORE
+    the verbosity gate: a quiet run still gets a complete timeline."""
+    global _tee
+    if _tee is None:
+        try:
+            from ..obs import trace as _obs_trace
+        except ImportError:
+            _tee = False
+            return
+        _tee = _obs_trace
+    if _tee is not False and _tee.enabled():
+        _tee.tee_event(kind, fields)
+
+
 def event(kind: str, **fields: Any) -> None:
     """Structured channel: one machine-parseable JSON record through the
     same callback seam as the human lines (INFO level, so `verbosity=0`
@@ -96,6 +116,7 @@ def event(kind: str, **fields: Any) -> None:
     enforces the same at lint time)."""
     if __debug__:
         _check_kind(kind)
+    _maybe_tee(kind, fields)
     if _level >= INFO:
         rec = {"event": kind}
         rec.update(fields)

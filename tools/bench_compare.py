@@ -41,7 +41,11 @@ under per-site fencing (``timing: "fenced"``), a different convention
 from the pipelined residual walls the headline metrics use, so they
 are ALWAYS informational — they explain a gated regression, they never
 gate themselves, and the two timing modes are never mixed in one
-comparison (see obs/ledger.py for the mode semantics).
+comparison (see obs/ledger.py for the mode semantics). The same
+contract covers per-device skew: when both endpoints' multichip curves
+carry ``device_round_ms`` the verdict adds an informational
+``device_imbalance`` block (per-device wall deltas + the imbalance
+trajectory); only the scalar ``mc_device_imbalance`` gates.
 
 Verdict JSON: ``{"schema", "records", "incomplete", "metrics": {name:
 {base, new, delta_pct, direction, verdict, series}}, "counts",
@@ -101,6 +105,8 @@ DIRECTION: Dict[str, int] = {
     "serve_model_density_x": +1,     # f32 bytes / compact bytes
     "mc_ingest_s": -1,               # stream-to-shard ingest wall
     "mc_ingest_overlap": +1,         # (parse+bin)/wall of the pipeline
+    "mc_device_imbalance": -1,       # max/median device round wall at
+                                     # the widest mesh (1.0 = balanced)
 }
 # quality metrics: tiny moves are real; gate at 0.5%, not the timing 5%
 QUALITY = frozenset({"auc", "auc_ours_1m_100it", "ndcg10"})
@@ -135,6 +141,7 @@ METRIC_STAGE = {
     "serve_hbm_per_model_mb_compact": "coldstart",
     "serve_model_density_x": "coldstart",
     "mc_ingest_s": "multichip", "mc_ingest_overlap": "multichip",
+    "mc_device_imbalance": "multichip",
 }
 # keys never judged nor listed as informational scalars
 _SKIP_KEYS = frozenset({"metric", "unit", "stage_reached", "stages_done",
@@ -228,6 +235,56 @@ def compare_terms(base: Dict[str, Any],
     return out or None
 
 
+def _widest_device_walls(rec: Dict[str, Any]
+                         ) -> Optional[Dict[str, Any]]:
+    curve = ((rec.get("multichip") or {}).get("curve")) or []
+    for leg in reversed(curve):
+        if isinstance(leg, dict) and leg.get("device_round_ms"):
+            return leg
+    return None
+
+
+def compare_devices(base: Dict[str, Any],
+                    new: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Informational per-device round-wall diff from the multichip
+    stage's widest curve leg. NEVER gates: per-device walls come from
+    the shard-by-shard wait-attribution drain (obs/profiler.py), a
+    different convention from the pipelined per_iter_ms the headline
+    judges — a skew shift explains an mc regression, it is not one
+    itself (the ``mc_device_imbalance`` scalar carries the gate)."""
+    b, n = _widest_device_walls(base), _widest_device_walls(new)
+    if b is None or n is None:
+        return None
+    rows = {}
+    b_ids = [str(d) for d in b.get("device_ids", [])]
+    n_ids = [str(d) for d in n.get("device_ids", [])]
+    b_ms = dict(zip(b_ids, b["device_round_ms"]))
+    n_ms = dict(zip(n_ids, n["device_round_ms"]))
+    for did in sorted(set(b_ms) | set(n_ms), key=str):
+        bv, nv = b_ms.get(did), n_ms.get(did)
+        row: Dict[str, Any] = {"base_ms": bv, "new_ms": nv}
+        if isinstance(bv, (int, float)) and bv \
+                and isinstance(nv, (int, float)):
+            row["delta_pct"] = round((nv - bv) / abs(bv) * 100.0, 1)
+        rows[f"d{did}"] = row
+    out: Dict[str, Any] = {"verdict": "informational",
+                           "devices": rows,
+                           "base_mesh": b.get("devices"),
+                           "new_mesh": n.get("devices")}
+    bi, ni = b.get("device_imbalance"), n.get("device_imbalance")
+    if bi is not None and ni is not None:
+        out["imbalance"] = {"base": bi, "new": ni}
+        worst = max((r for r in rows.values()
+                     if "delta_pct" in r),
+                    key=lambda r: abs(r["delta_pct"]), default=None)
+        if worst is not None:
+            slow = next(d for d, r in rows.items() if r is worst)
+            out["attribution"] = (f"multichip: {slow} "
+                                  f"{worst['delta_pct']:+.0f}% "
+                                  f"(imbalance {bi} -> {ni})")
+    return out
+
+
 def compare(records: List[Tuple[str, Optional[Dict[str, Any]]]],
             threshold_pct: float = 5.0) -> Dict[str, Any]:
     complete = [(lbl, rec) for lbl, rec in records if rec is not None]
@@ -304,6 +361,10 @@ def compare(records: List[Tuple[str, Optional[Dict[str, Any]]]],
     terms = compare_terms(base, new)
     if terms is not None:
         out["terms_by_stage"] = terms
+    # same contract for per-device skew: informational only
+    devices = compare_devices(base, new)
+    if devices is not None:
+        out["device_imbalance"] = devices
     out["overall"] = ("regressed" if counts["regressed"]
                       else "improved" if counts["improved"]
                       else "neutral")

@@ -14,7 +14,11 @@ Inputs (each optional — the report ranks whatever is available):
                     roofline classification, measured dispatch wall)
   --trace-summary PATH
                     trace_summary.json (compile-cache miss attribution)
-  --bench PATH      a BENCH record (terms_by_stage from bench.py)
+  --bench PATH      a BENCH record (terms_by_stage from bench.py);
+                    timeout-truncated records (incomplete:true, or a
+                    driver wrapper with rc=124 / parsed:null like
+                    BENCH_r05) still report — stage reached, time
+                    in-stage, completed stage walls, partial terms
   --json PATH       also write the full report as JSON
   --top N           rows per section in the text report (default 8)
 
@@ -152,6 +156,64 @@ def miss_rows(summary, top):
                                key=lambda kv: -kv[1])[:top]]
 
 
+def incomplete_info(bench):
+    """Interruption forensics for timeout-truncated BENCH records.
+
+    Two truncation shapes exist:
+
+    * driver wrapper with ``rc != 0`` and ``parsed: null`` — the
+      BENCH_r05 failure mode (the summary line never printed); the
+      stderr ``tail``'s stage markers are all there is to report;
+    * a BenchRecorder sidecar/stdout record with ``incomplete: true``
+      — carries ``stage_reached``, ``elapsed_s``, the cumulative
+      ``stage_wall_s`` walls and partial ``terms_by_stage``, so the
+      report can say exactly where the kill landed and how long the
+      run had been inside that stage.
+
+    None for a complete record."""
+    rc = tail = None
+    if isinstance(bench, dict) and "parsed" in bench and "rc" in bench:
+        rc = bench.get("rc")
+        tail = bench.get("tail")
+        bench = bench.get("parsed")
+    truncated = bool(rc) or (isinstance(bench, dict)
+                             and bench.get("incomplete"))
+    if not truncated:
+        return None
+    info = {"incomplete": True}
+    if rc:
+        info["rc"] = rc
+        info["killed_by_timeout"] = rc == 124
+    if isinstance(bench, dict):
+        if bench.get("stage_reached"):
+            info["stage_reached"] = bench["stage_reached"]
+        if bench.get("stages_done"):
+            info["stages_done"] = list(bench["stages_done"])
+        walls = bench.get("stage_wall_s") or {}
+        if walls:
+            info["stage_wall_s"] = walls
+        if bench.get("elapsed_s") is not None:
+            el = float(bench["elapsed_s"])
+            info["elapsed_s"] = el
+            # time inside the interrupted stage = total elapsed minus
+            # what the COMPLETED stages account for
+            info["time_in_stage_s"] = round(
+                max(el - sum(walls.values()), 0.0), 1)
+        if bench.get("interrupted_by"):
+            info["interrupted_by"] = bench["interrupted_by"]
+        if bench.get("stage_skips"):
+            info["stage_skips"] = bench["stage_skips"]
+    elif tail:
+        # parsed:null legacy wrapper: scrape the stage markers bench.py
+        # printed to stderr before the kill
+        markers = [ln for ln in str(tail).splitlines()
+                   if ln.startswith("#")]
+        info["parsed"] = None
+        if markers:
+            info["last_markers"] = markers[-6:]
+    return info
+
+
 def stage_rows(bench):
     # driver wrapper records ({"n", "cmd", "rc", "parsed"} — the
     # BENCH_r0*.json series) carry the summary under "parsed"
@@ -218,6 +280,9 @@ def build_report(args):
             report["captures"] = prof["captures"]
     bench = _load_json(args.bench, "bench record")
     if bench:
+        inc = incomplete_info(bench)
+        if inc:
+            report["incomplete"] = inc
         report["terms_by_stage"], pipelines = stage_rows(bench)
         if pipelines:
             report["ingest_pipeline"] = pipelines
@@ -229,6 +294,26 @@ def print_report(report, top):
     p("=" * 64)
     p("bottleneck report — ranked device-time attribution")
     p("=" * 64)
+    inc = report.get("incomplete")
+    if inc:
+        p("\nINTERRUPTED RUN — partial record:")
+        if inc.get("rc") is not None:
+            kill = "  (driver timeout kill)" \
+                if inc.get("killed_by_timeout") else ""
+            p(f"     rc={inc['rc']}{kill}")
+        if inc.get("stage_reached"):
+            where = f"     died inside stage {inc['stage_reached']!r}"
+            if inc.get("time_in_stage_s") is not None:
+                where += f" after {inc['time_in_stage_s']}s in-stage"
+            if inc.get("elapsed_s") is not None:
+                where += f" ({inc['elapsed_s']}s total)"
+            p(where)
+        if inc.get("interrupted_by"):
+            p(f"     interrupted by: {inc['interrupted_by']}")
+        for stage, wall in (inc.get("stage_wall_s") or {}).items():
+            p(f"     done: {stage:<14} {wall:>8.1f} s")
+        for ln in inc.get("last_markers") or []:
+            p(f"     tail: {ln}")
     ranked = report.get("ranked_terms") or []
     if ranked:
         p(f"\nfenced terms (mean over profiled rounds "
@@ -298,7 +383,7 @@ def main(argv=None):
     report = build_report(args)
     has_data = any(report.get(k) for k in
                    ("ranked_terms", "programs", "compile_misses",
-                    "terms_by_stage"))
+                    "terms_by_stage", "incomplete"))
     print_report(report, args.top)
     if args.json_out:
         with open(args.json_out, "w") as fh:
