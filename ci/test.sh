@@ -496,6 +496,327 @@ else
     rm -rf "$(dirname "$MET_DIR")"
 fi
 
+echo "== front door smoke (task=serve HTTP scoring: QoS shed, hot swap, placement) =="
+FD_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_frontdoor"
+mkdir -p "$FD_DIR"
+# two boosters: the checkpoint-served model (gold class, hot-swapped
+# live) and a bulk model (bronze) for the forced-overload leg; a v2 of
+# the checkpoint model stages the mid-traffic swap
+LGBT_FD_DIR="$FD_DIR" python - <<'EOF'
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sweep.refresh import write_serving_checkpoint
+
+fdir = os.environ["LGBT_FD_DIR"]
+rng = np.random.RandomState(11)
+X = rng.rand(1200, 6).astype(np.float32)
+y = (X[:, 0] + 0.3 * rng.randn(1200) > 0.5).astype(np.float32)
+texts = []
+for seed in (0, 1, 2):
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "seed": seed,
+                     "feature_fraction": 0.9,
+                     "feature_fraction_seed": seed + 1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    texts.append(bst.model_to_string())
+with open(os.path.join(fdir, "bulk.txt"), "w") as fh:
+    fh.write(texts[1])
+with open(os.path.join(fdir, "v2.txt"), "w") as fh:
+    fh.write(texts[2])
+assert write_serving_checkpoint(os.path.join(fdir, "ckpt"),
+                                texts[0]) == "ckpt_000001"
+EOF
+FD_PORT=$(python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)
+FD_MET_PORT=$(python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)
+# 4 emulated devices so the placer is live; the tiny SLO makes every
+# request an SLO breach, so the bronze model's burn rate saturates and
+# admission MUST shed it under overload — while the gold-class
+# checkpoint model is never shed by contract
+XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+python -m lightgbm_tpu task=serve \
+    "input_model=bulk_m=$FD_DIR/bulk.txt" \
+    "tpu_checkpoint_dir=$FD_DIR/ckpt" \
+    "tpu_serve_port=$FD_PORT" \
+    "tpu_serve_qos=checkpoint:gold,default:bronze" \
+    "tpu_serve_metrics_port=$FD_MET_PORT" \
+    tpu_serve_devices=4 tpu_serve_replicas=2 \
+    tpu_serve_trace=true tpu_serve_slo_ms=0.0001 \
+    tpu_serve_watch_interval_s=0.2 \
+    tpu_serve_max_batch_wait_ms=1 tpu_serve_max_batch_rows=2048 \
+    tpu_serve_hold_s=300 \
+    verbosity=-1 > "$FD_DIR/serve.log" 2>&1 &
+FD_PID=$!
+for _ in $(seq 1 240); do
+    grep -q '^Holding' "$FD_DIR/serve.log" 2>/dev/null && break
+    sleep 0.25
+done
+grep -q '^Scoring: POST' "$FD_DIR/serve.log"
+LGBT_FD_DIR="$FD_DIR" LGBT_FD_PORT="$FD_PORT" \
+LGBT_FD_MET_PORT="$FD_MET_PORT" python - <<'EOF'
+import http.client
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+fdir = os.environ["LGBT_FD_DIR"]
+port = int(os.environ["LGBT_FD_PORT"])
+met = f"http://127.0.0.1:{os.environ['LGBT_FD_MET_PORT']}"
+rng = np.random.RandomState(3)
+body = json.dumps({"rows": rng.rand(16, 6).tolist()}).encode()
+one_row = json.dumps({"rows": rng.rand(1, 6).tolist()}).encode()
+
+
+def post(conn, model, payload=body):
+    conn.request("POST", f"/v1/score/{model}", body=payload,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, resp.read()
+
+
+def healthz():
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+        assert resp.status == 200
+        return json.load(resp)
+
+
+def scrape():
+    with urllib.request.urlopen(met + "/metrics", timeout=10) as resp:
+        return resp.read().decode()
+
+
+def closed_loop(model, clients, secs):
+    """clients threads, keep-alive connections; returns (n_ok, codes)."""
+    stop = time.perf_counter() + secs
+    codes = {}
+    lock = threading.Lock()
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            while time.perf_counter() < stop:
+                status, _ = post(conn, model)
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return codes.get(200, 0), codes
+
+
+# -- /healthz schema ----------------------------------------------------
+doc = healthz()
+assert doc["schema"] == 1 and doc["status"] == "ok"
+assert sorted(doc["models"]) == ["bulk_m", "checkpoint"]
+assert doc["qos"] == {"checkpoint": "gold", "default": "bronze"}
+assert doc["devices"] == 4
+for key in ("shedding", "admission", "replicas", "placement"):
+    assert key in doc, key
+
+# -- coalesced socket throughput >= 3x single-request sockets ----------
+n_direct, codes = closed_loop("checkpoint", 1, 1.5)
+assert codes == {200: n_direct}, codes
+n_coal, codes = closed_loop("checkpoint", 16, 1.5)
+assert codes == {200: n_coal}, codes
+ratio = (n_coal / 1.5) / max(n_direct / 1.5, 1e-9)
+assert ratio >= 3.0, (n_direct, n_coal, ratio)
+
+# -- placement: traffic replicates the hot model across devices --------
+deadline = time.time() + 60
+while time.time() < deadline:
+    if healthz()["replicas"].get("checkpoint", 0) >= 2:
+        break
+    closed_loop("checkpoint", 8, 0.5)   # keep the route counter moving
+doc = healthz()
+assert doc["replicas"]["checkpoint"] >= 2, doc["replicas"]
+devs = {r["device"] for r in doc["placement"]["models"]["checkpoint"]}
+assert len(devs) >= 2, doc["placement"]
+text = scrape()
+gauge_devs = set(re.findall(r'serve_device_queue_rows\{device="(\d+)"\}',
+                            text))
+assert len(gauge_devs) >= 2, gauge_devs
+assert 'serve_model_replicas{model="checkpoint"}' in text
+assert 'serve_http_requests_total{code="200"}' in text
+
+# -- hot swap under threaded HTTP load: zero failures ------------------
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+status, data = post(conn, "checkpoint", one_row)
+conn.close()
+assert status == 200
+before = json.loads(data)["predictions"]
+
+stop_flag = []
+swap_codes = {}
+lock = threading.Lock()
+
+
+def hammer():
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        while not stop_flag:
+            status, _ = post(conn, "checkpoint")
+            with lock:
+                swap_codes[status] = swap_codes.get(status, 0) + 1
+    finally:
+        conn.close()
+
+
+threads = [threading.Thread(target=hammer) for _ in range(8)]
+for t in threads:
+    t.start()
+time.sleep(0.5)
+from lightgbm_tpu.sweep.refresh import write_serving_checkpoint
+assert write_serving_checkpoint(
+    os.path.join(fdir, "ckpt"),
+    open(os.path.join(fdir, "v2.txt")).read()) == "ckpt_000002"
+deadline = time.time() + 30
+while time.time() < deadline:
+    if "serve_model_swaps_total 1" in scrape():
+        break
+    time.sleep(0.2)
+time.sleep(0.5)                  # post-swap traffic through new engine
+stop_flag.append(True)
+for t in threads:
+    t.join()
+assert "serve_model_swaps_total 1" in scrape(), "swap never landed"
+assert set(swap_codes) == {200}, swap_codes
+assert swap_codes[200] > 0
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+status, data = post(conn, "checkpoint", one_row)
+conn.close()
+assert status == 200
+after = json.loads(data)["predictions"]
+assert not np.allclose(before, after), "swap did not change scores"
+
+# -- forced overload: bronze sheds with 429s, gold NEVER ---------------
+# fill bulk_m's burn window (every request breaches the tiny SLO); the
+# shed can trip MID-warm-up once 16 outcomes land, so tally any early
+# 429s — the exact-count check below covers them too
+warm_429 = 0
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+ok = 0
+for _ in range(100):
+    status, _ = post(conn, "bulk_m")
+    ok += status == 200
+    warm_429 += status == 429
+    if ok >= 16:
+        break
+conn.close()
+deadline = time.time() + 15
+while time.time() < deadline:    # healthz refreshes the shed state
+    if "bulk_m" in healthz()["shedding"]:
+        break
+    time.sleep(0.1)
+assert "bulk_m" in healthz()["shedding"], "shed never tripped"
+
+codes = {"bulk_m": {}, "checkpoint": {}}
+
+
+def overload(model):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    stop = time.perf_counter() + 2.0
+    try:
+        while time.perf_counter() < stop:
+            status, _ = post(conn, model)
+            with lock:
+                codes[model][status] = codes[model].get(status, 0) + 1
+    finally:
+        conn.close()
+
+
+threads = ([threading.Thread(target=overload, args=("bulk_m",))
+            for _ in range(12)]
+           + [threading.Thread(target=overload, args=("checkpoint",))
+              for _ in range(2)])
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+shed_429 = codes["bulk_m"].get(429, 0) + warm_429
+assert codes["bulk_m"].get(429, 0) > 0, codes
+assert set(codes["checkpoint"]) == {200}, codes   # gold never shed
+doc = healthz()
+assert "bulk_m" in doc["shedding"], doc["shedding"]
+admission = doc["admission"]
+assert admission["sheds"] == shed_429, (admission["sheds"], shed_429)
+assert "gold" not in admission["sheds_by_class"], admission
+# the Prometheus counter agrees exactly with the client-observed 429s
+text = scrape()
+shed_series = re.findall(
+    r'serve_shed_total\{model="bulk_m",qos="bronze"\} (\d+)', text)
+assert shed_series and int(shed_series[0]) == shed_429, \
+    (shed_series, shed_429)
+m429 = re.findall(r'serve_http_requests_total\{code="429"\} (\d+)', text)
+assert m429 and int(m429[0]) == shed_429, (m429, shed_429)
+
+# -- traffic JSON artifact ---------------------------------------------
+artifact = {
+    "schema": 1,
+    "http_direct_rps": round(n_direct / 1.5, 1),
+    "http_coalesced_rps": round(n_coal / 1.5, 1),
+    "http_vs_direct": round(ratio, 2),
+    "replicas": doc["replicas"],
+    "swap_codes": {str(k): v for k, v in sorted(swap_codes.items())},
+    "overload_codes": {m: {str(k): v for k, v in sorted(c.items())}
+                       for m, c in codes.items()},
+    "sheds": admission["sheds"],
+    "sheds_by_class": admission["sheds_by_class"],
+}
+with open(os.path.join(fdir, "frontdoor_traffic.json"), "w") as fh:
+    json.dump(artifact, fh, sort_keys=True)
+chk = json.load(open(os.path.join(fdir, "frontdoor_traffic.json")))
+assert chk["schema"] == 1
+for key in ("http_vs_direct", "replicas", "swap_codes",
+            "overload_codes", "sheds"):
+    assert key in chk, key
+print(f"front door smoke: ok (coalesced {ratio:.1f}x single-request, "
+      f"{chk['replicas']['checkpoint']} replicas, "
+      f"{swap_codes[200]} reqs through live swap with 0 failures, "
+      f"{shed_429} bronze sheds / 0 gold)")
+EOF
+kill -INT "$FD_PID" 2>/dev/null || true
+set +e
+wait "$FD_PID"
+FD_RC=$?
+set -e
+if [ "$FD_RC" -ne 0 ]; then
+    echo "FAIL: front-door serve process exited $FD_RC (want clean 0)" >&2
+    cat "$FD_DIR/serve.log" >&2
+    exit 1
+fi
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+    echo "front-door artifacts kept under $FD_DIR for artifact upload"
+else
+    rm -rf "$(dirname "$FD_DIR")"
+fi
+
 echo "== AOT serving artifact smoke (zero-trace cold start + compact parity) =="
 AOT_DIR="${CI_ARTIFACT_DIR:-$(mktemp -d)}/lgbt_aot"
 mkdir -p "$AOT_DIR"

@@ -327,6 +327,10 @@ class Application:
                 if svc.tracer is not None:
                     print(f"Request traces: {svc.exporter.url}"
                           f"/debug/requests", flush=True)
+            if svc.frontend is not None:
+                print(f"Scoring: POST {svc.frontend.url}/v1/score/"
+                      f"<model> (health: {svc.frontend.url}/healthz)",
+                      flush=True)
             if cfg.tpu_serve_hold_s > 0:
                 # scrape/hot-swap window: hold the service up, exit
                 # early and cleanly on Ctrl-C / SIGTERM

@@ -680,6 +680,53 @@ class Config:
     # that model (serve_compact_fallback). Runtime-only: excluded from
     # model text and checkpoint signatures
     tpu_serve_compact_tol: float = 0.05
+    # serving network front door (serving/frontend/): TCP port for the
+    # scoring HTTP endpoint — POST /v1/score/<model> (JSON rows or
+    # packed-binary float rows) submitted through QoS admission into
+    # the request coalescer, GET /healthz readiness. Binds 127.0.0.1.
+    # 0 disables the front door. Runtime-only: excluded from model
+    # text and checkpoint signatures, like the other serving knobs
+    tpu_serve_port: int = 0
+    # per-model QoS classes for front-door admission:
+    # "model:class,..." with classes gold (highest, never shed),
+    # silver, bronze (or 0/1/2). A "default:class" item sets the class
+    # of unlisted models; without one they serve as bronze. Higher
+    # classes dispatch first under saturation; lower classes are load-
+    # shed (fast 429 + serve_shed event) while a model's SLO burn rate
+    # is above the shed watermark
+    tpu_serve_qos: str = ""
+    # front-door load shedding: "auto" (shed exactly when the request
+    # tracer + SLO are live, i.e. tpu_serve_trace with a nonzero
+    # tpu_serve_slo_ms), "on", or "off". Shedding trips per model on
+    # the rolling serve_slo_burn_rate gauge (obs/reqtrace.py) with
+    # hysteresis, sheds only classes below gold, and clears when the
+    # burn rate falls back under the clear watermark
+    tpu_serve_shed: str = "auto"
+    # SLO burn rate at or above which front-door shedding trips for a
+    # model (fraction of breaching/errored requests over the rolling
+    # burn window)
+    tpu_serve_shed_high: float = 0.5
+    # burn rate at or below which a tripped model stops shedding (must
+    # be < tpu_serve_shed_high; the gap is the hysteresis band)
+    tpu_serve_shed_low: float = 0.25
+    # admission window in rows: the front-door dispatcher keeps at most
+    # this many rows in flight toward the coalescer; excess requests
+    # wait in per-class priority queues (highest class dispatches
+    # first). 0 = twice tpu_serve_max_batch_rows
+    tpu_serve_admit_rows: int = 0
+    # devices the serving placer spreads models across: 1 (default)
+    # keeps every forest on the default device and the placer off;
+    # 0 = all visible devices; N > 1 = the first N. With more than one
+    # device the per-model forests are pinned per device by HBM
+    # headroom, hot models are replicated (serve_place events), each
+    # batch routes to the replica with the shallowest queue, and
+    # tpu_serve_hbm_budget_mb becomes a PER-DEVICE budget with
+    # per-device LRU eviction of replicas
+    tpu_serve_devices: int = 1
+    # replica ceiling per model for the placer's hot-model replication
+    # (request-rate ranked; replication only fills free per-device
+    # headroom, it never evicts for a copy)
+    tpu_serve_replicas: int = 2
     # runtime lock-discipline assertions (utils/locks.py): install a
     # checking __setattr__ on the serving/metrics classes whose shared
     # state is declared `# guarded-by:` — a guarded attribute rebound
@@ -861,6 +908,22 @@ class Config:
             raise ValueError(
                 f"tpu_timeline must be off/on/auto, got "
                 f"{self.tpu_timeline!r}")
+        self.tpu_serve_shed = self.tpu_serve_shed.strip().lower()
+        if self.tpu_serve_shed not in ("off", "on", "auto"):
+            raise ValueError(
+                f"tpu_serve_shed must be off/on/auto, got "
+                f"{self.tpu_serve_shed!r}")
+        if not 0.0 < self.tpu_serve_shed_low < self.tpu_serve_shed_high \
+                <= 1.0:
+            raise ValueError(
+                "need 0 < tpu_serve_shed_low < tpu_serve_shed_high <= 1, "
+                f"got low={self.tpu_serve_shed_low!r} "
+                f"high={self.tpu_serve_shed_high!r}")
+        if self.tpu_serve_qos:
+            # full parsing lives in serving/frontend/qos.py; the config
+            # layer rejects syntactically-broken specs at startup
+            from .serving.frontend.qos import parse_qos
+            parse_qos(self.tpu_serve_qos)
 
     def _check_conflicts(self) -> None:
         """Parameter-conflict resolution (reference `CheckParamConflict`

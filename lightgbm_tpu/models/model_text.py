@@ -38,6 +38,11 @@ _RUNTIME_ONLY_PARAMS = frozenset({
     "tpu_serve_hold_s", "tpu_serve_trace", "tpu_serve_trace_dir",
     "tpu_serve_trace_sample", "tpu_serve_trace_ring", "tpu_serve_slo_ms",
     "tpu_serve_aot_dir", "tpu_serve_compact", "tpu_serve_compact_tol",
+    # network front door (serving/frontend/): admission, shedding and
+    # placement shape traffic, never the model
+    "tpu_serve_port", "tpu_serve_qos", "tpu_serve_shed",
+    "tpu_serve_shed_high", "tpu_serve_shed_low", "tpu_serve_admit_rows",
+    "tpu_serve_devices", "tpu_serve_replicas",
     "tpu_profile", "tpu_profile_every",
     "tpu_profile_capture", "tpu_debug_locks",
     # timeline + straggler/anomaly watches: observability only

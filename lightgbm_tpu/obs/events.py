@@ -56,13 +56,26 @@ EVENTS: Dict[str, str] = {
     "serve_compact_fallback": "compact plan FAILED the parity gate; the "
                               "load fell back to the f32 engine",
     "serve_compile": "ForestEngine compiled a new shape-bucket program",
+    "serve_deadline": "front-door request expired its X-Deadline-Ms "
+                      "budget in the admission queue and was answered "
+                      "without an engine dispatch (rate-limited)",
     "serve_evict": "registry evicted an LRU entry over the HBM budget",
+    "serve_frontend": "scoring front door started or stopped: bind "
+                      "address, QoS map, shed mode, request totals",
     "serve_load": "registry loaded (or replaced) a named model",
+    "serve_place": "placer assigned/replicated/evicted a model replica "
+                   "on a device (HBM-headroom placement; per-device "
+                   "LRU budget)",
     "serve_over_budget": "a single protected entry alone exceeds the "
                          "HBM budget (load proceeds with a warning)",
     "serve_request_slow": "a coalesced request breached tpu_serve_slo_ms "
                           "(rate-limited pointer; the full span is in "
                           "the request-trace ring/JSONL)",
+    "serve_route": "placer first routed a model's traffic to a replica "
+                   "on a device (edge-triggered per model/device pair)",
+    "serve_shed": "front-door load shedding tripped or cleared for a "
+                  "model (burn-rate hysteresis) with the running shed "
+                  "count; shed requests get fast 429s",
     "serve_slo_burn": "a model's rolling SLO burn rate crossed the high "
                       "watermark — the load-shedding trip signal",
     "serve_swap": "registry hot-swapped a named model to a new version",

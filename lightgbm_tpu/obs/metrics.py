@@ -509,6 +509,38 @@ def serving_instruments() -> Any:
         "serve_early_stop_total",
         "prediction chunks that exited before scoring every tree "
         "(pred_early_stop on the batched engine path)")
+    # network front door (serving/frontend/): admission, shedding,
+    # placement. Same zero-overhead-off discipline — the bundle is
+    # resolved once at construction, None when the plane is off.
+    ns.http_requests = r.counter(
+        "serve_http_requests_total",
+        "front-door HTTP requests by response code",
+        labelnames=("code",))
+    ns.shed = r.counter(
+        "serve_shed_total",
+        "front-door requests load-shed with a 429 while the model's "
+        "SLO burn rate was above the shed watermark",
+        labelnames=("model", "qos"))
+    ns.deadline_expired = r.counter(
+        "serve_deadline_expired_total",
+        "front-door requests that expired their X-Deadline-Ms budget "
+        "in the admission queue (answered without dispatch)",
+        labelnames=("model",))
+    ns.admit_depth = r.gauge(
+        "serve_admit_queue_depth",
+        "front-door admission queue depth (requests waiting) per QoS "
+        "class",
+        labelnames=("qos",))
+    ns.device_queue = r.gauge(
+        "serve_device_queue_rows",
+        "rows in flight toward each device's replicas (the placer's "
+        "shallowest-queue routing signal)",
+        labelnames=("device",))
+    ns.replicas = r.gauge(
+        "serve_model_replicas",
+        "device replicas resident per model (placer hot-model "
+        "replication)",
+        labelnames=("model",))
     return ns
 
 

@@ -309,6 +309,8 @@ class ForestEngine:
         self.predict_calls = 0
         self.aot_hits = 0               # chunk dispatches via AOT artifact
         self.aot_source: Optional[str] = None
+        self.device = None              # jax device pin (to_device); None
+                                        # = default-device placement
         self.early_stop_exits = 0       # chunks that exited before all trees
         self._jit_run = jax.jit(self._run)
         self._jit_run_routed = jax.jit(self._run_routed)
@@ -394,6 +396,23 @@ class ForestEngine:
         if self._f32_bytes is None:
             return self.device_bytes()
         return int(self._f32_bytes)
+
+    def to_device(self, device) -> "ForestEngine":
+        """Pin the resident forest onto one jax device (the serving
+        placer's per-device replica residency). The stacked arrays are
+        committed to `device`; chunk dispatches then run there because
+        jit follows the committed operand. Early-stop sub-stacks and
+        AOT executables are device-bound state, so both caches are
+        dropped (AOT artifacts re-attach only on the default device)."""
+        self._stk = {k: jax.device_put(v, device)
+                     for k, v in self._stk.items()}
+        if self._route is not None:
+            self._route = {k: jax.device_put(v, device)
+                           for k, v in self._route.items()}
+        self._es_cache = {}
+        self._aot_calls = {}
+        self.device = device
+        return self
 
     def attach_aot(self, calls: Dict[int, object],
                    source: Optional[str] = None) -> None:

@@ -20,12 +20,21 @@ layer:
 - `MetricsExporter` (exporter.py): the `/metrics` + `/metrics.json`
   HTTP endpoint over the process metrics registry (obs/metrics.py) and
   HBM accountant (obs/memory.py); wired by `tpu_serve_metrics_port`.
+- `frontend/` (ScoringFrontend / AdmissionController / Placer): the
+  network front door — `POST /v1/score/<model>` over QoS priority
+  admission with burn-rate load shedding, and multi-device model
+  placement with hot-model replication; wired by `tpu_serve_port`,
+  `tpu_serve_qos` and `tpu_serve_devices`.
 """
 from .coalescer import RequestCoalescer  # noqa: F401
 from .exporter import MetricsExporter  # noqa: F401
+from .frontend import (AdmissionController, DeadlineExpired,  # noqa: F401
+                       Placer, ScoringFrontend, ShedError)
 from .registry import ModelEntry, ModelRegistry  # noqa: F401
 from .service import ServingService  # noqa: F401
 from .watcher import CheckpointWatcher  # noqa: F401
 
 __all__ = ["ModelEntry", "ModelRegistry", "RequestCoalescer",
-           "CheckpointWatcher", "ServingService", "MetricsExporter"]
+           "CheckpointWatcher", "ServingService", "MetricsExporter",
+           "ScoringFrontend", "AdmissionController", "Placer",
+           "ShedError", "DeadlineExpired"]

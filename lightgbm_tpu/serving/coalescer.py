@@ -56,8 +56,13 @@ class RequestCoalescer:
     """SLO-aware batcher in front of a `ModelRegistry`."""
 
     def __init__(self, registry, max_batch_wait_ms: float = 2.0,
-                 max_batch_rows: int = 8192, tracer=None) -> None:
+                 max_batch_rows: int = 8192, tracer=None,
+                 placer=None) -> None:
         self.registry = registry
+        # multi-device placer (serving/frontend/placement.py): when
+        # attached, each flush routes to the replica with the
+        # shallowest queue instead of the entry's default engine
+        self._placer = placer
         self.wait_s = max(float(max_batch_wait_ms), 0.0) / 1e3
         self.max_batch_rows = max(int(max_batch_rows), 1)
         # request tracer (obs/reqtrace.py): None when tpu_serve_trace is
@@ -204,15 +209,29 @@ class RequestCoalescer:
         tr = self._tracer
         batch_id = tr.next_batch_id() if tr is not None else None
         t_start = time.perf_counter()   # flusher picked the batch up
+        replica = None
         try:
             entry = self.registry.acquire(model)
             X = (batch[0].X if len(batch) == 1
                  else np.concatenate([r.X for r in batch], axis=0))
             eng = entry.engine
+            if self._placer is not None:
+                # routing failure degrades to the entry's own engine —
+                # placement is an optimization, never a request killer
+                try:
+                    replica = self._placer.route(model, entry, rows)
+                    eng = replica.engine
+                except Exception:  # noqa: BLE001
+                    replica = None
             t_d0 = time.perf_counter()
-            with obs_trace.span("serving.flush", model=model, rows=rows,
-                                requests=len(batch), reason=reason):
-                margins, _ = eng.predict(X)
+            try:
+                with obs_trace.span("serving.flush", model=model,
+                                    rows=rows, requests=len(batch),
+                                    reason=reason):
+                    margins, _ = eng.predict(X)
+            finally:
+                if replica is not None:
+                    self._placer.done(replica, rows)
             t_d1 = time.perf_counter()
             padded = sum(eng._bucket(min(rows - lo, eng.chunk_rows))
                          for lo in range(0, max(rows, 1), eng.chunk_rows))

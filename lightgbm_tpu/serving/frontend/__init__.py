@@ -1,0 +1,29 @@
+"""Network front door for the serving plane (ROADMAP item 2).
+
+Three layers between the wire and the coalescer:
+
+- `http.ScoringFrontend` — the socket: ``POST /v1/score/<model>``
+  (JSON or packed-binary rows, ``X-Deadline-Ms`` deadlines) and
+  ``GET /healthz``, on the exporter's stdlib ThreadingHTTPServer
+  pattern;
+- `qos.AdmissionController` — per-model QoS classes
+  (``tpu_serve_qos``), strict-priority dispatch under a bounded
+  in-flight window, burn-rate load shedding with hysteresis (fast 429,
+  gold never shed), deadline expiry without dispatch;
+- `placement.Placer` — multi-device residency: HBM-headroom
+  assignment, request-rate-ranked hot-model replication, shallowest-
+  queue routing, per-device LRU budget (``tpu_serve_devices`` /
+  ``tpu_serve_replicas``).
+
+`ServingService` wires all three from the ``tpu_serve_*`` params; the
+pieces also compose individually (the tests drive each in isolation).
+"""
+from .http import ScoringFrontend  # noqa: F401
+from .placement import Placer, Replica, resolve_devices  # noqa: F401
+from .qos import (AdmissionController, DeadlineExpired,  # noqa: F401
+                  QOS_CLASSES, QOS_NAMES, ShedError, parse_qos,
+                  qos_class)
+
+__all__ = ["ScoringFrontend", "AdmissionController", "Placer", "Replica",
+           "ShedError", "DeadlineExpired", "parse_qos", "qos_class",
+           "resolve_devices", "QOS_CLASSES", "QOS_NAMES"]

@@ -1,0 +1,326 @@
+"""ScoringFrontend: the serving plane's network front door.
+
+The exporter (serving/exporter.py) proved the shape — a stdlib
+`ThreadingHTTPServer` (no new dependencies), one bound handler class
+per instance, `port=0` for an OS-assigned ephemeral port. This module
+extends that pattern from scrape-only to the scoring path itself:
+
+* ``POST /v1/score/<model>`` — score rows. Two body encodings:
+  - JSON (``Content-Type: application/json``): ``{"rows": [[...],
+    ...]}`` or a bare list-of-lists;
+  - packed binary (``Content-Type: application/octet-stream``):
+    row-major little-endian floats, ``X-Num-Features`` required,
+    ``X-Dtype: f32|f64`` (default f32) — the zero-copy path for fat
+    clients.
+  Optional ``X-Deadline-Ms`` bounds the request end to end: expired in
+  the admission queue -> 504 without an engine dispatch. The response
+  is JSON (``{"model", "rows", "predictions"}``) unless the client
+  sends ``Accept: application/octet-stream`` (f32 LE bytes + an
+  ``X-Shape`` header).
+* ``GET /healthz`` — readiness document: resident models, device and
+  replica counts, QoS map, currently-shedding models. Schema-checked
+  by CI.
+
+Status mapping is the admission layer's policy surface: 400 malformed
+(validated HERE — a bad body never reaches the coalescer), 404 unknown
+model, 429 shed (``Retry-After: 1``; counted in
+``serve_shed_total{model,qos}``), 504 deadline expired, 503 shutting
+down, 500 engine error. Handler threads block on the request future —
+the coalescer's batching, the placer's routing, and the tracer's spans
+all behave exactly as for in-process callers.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...utils import log
+from .qos import QOS_NAMES, DeadlineExpired, ShedError
+
+__all__ = ["ScoringFrontend"]
+
+# request-body ceiling: 256 MiB of f64 rows is far beyond any sane
+# request and cheap insurance against a runaway client
+_MAX_BODY = 256 << 20
+_SCORE_PREFIX = "/v1/score/"
+# how long a handler thread waits on the admission future when the
+# client sent no deadline of its own
+_DEFAULT_WAIT_S = 60.0
+
+
+class _BadRequest(ValueError):
+    """Parse/validation failure -> 400; never reaches the coalescer."""
+
+
+def _parse_json_rows(body: bytes) -> np.ndarray:
+    try:
+        doc = json.loads(body)
+    except Exception as exc:
+        raise _BadRequest(f"body is not valid JSON: {exc}") from None
+    rows = doc.get("rows") if isinstance(doc, dict) else doc
+    if not isinstance(rows, list) or not rows:
+        raise _BadRequest("need a non-empty 'rows' list of feature rows")
+    try:
+        X = np.asarray(rows, np.float64)
+    except Exception:
+        raise _BadRequest("rows are not numeric or not rectangular") \
+            from None
+    if X.ndim != 2 or X.shape[1] == 0:
+        raise _BadRequest(
+            f"rows must be 2-D [n, num_features], got shape {X.shape}")
+    return X
+
+
+def _parse_binary_rows(body: bytes, headers) -> np.ndarray:
+    feats = headers.get("X-Num-Features")
+    if not feats or not feats.isdigit() or int(feats) == 0:
+        raise _BadRequest(
+            "packed-binary bodies need X-Num-Features: <positive int>")
+    nfeat = int(feats)
+    dt = (headers.get("X-Dtype") or "f32").strip().lower()
+    if dt not in ("f32", "f64"):
+        raise _BadRequest(f"X-Dtype must be f32 or f64, got {dt!r}")
+    itemsize = 4 if dt == "f32" else 8
+    if not body or len(body) % (itemsize * nfeat) != 0:
+        raise _BadRequest(
+            f"body length {len(body)} is not a whole number of "
+            f"{nfeat}-feature {dt} rows")
+    flat = np.frombuffer(body, dtype=("<f4" if dt == "f32" else "<f8"))
+    return flat.reshape(-1, nfeat).astype(np.float64)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    frontend: "ScoringFrontend" = None  # set per server instance
+    protocol_version = "HTTP/1.1"       # keep-alive: bench clients reuse
+
+    # -- plumbing ----------------------------------------------------------
+    def _reply(self, code: int, body: bytes, ctype: str,
+               extra: Optional[Dict[str, str]] = None) -> None:
+        # count BEFORE the body goes out: a client that has read the
+        # response must already see it in requests_by_code/metrics
+        self.frontend._count(code)
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, doc: Dict[str, Any],
+                    extra: Optional[Dict[str, str]] = None) -> None:
+        self._reply(code, json.dumps(doc, sort_keys=True,
+                                     default=str).encode(),
+                    "application/json", extra)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/", "/healthz"):
+                self._reply_json(200, self.frontend.render_healthz())
+            else:
+                self._reply_json(404, {"error": "not found",
+                                       "path": path})
+        except Exception as exc:  # noqa: BLE001 — a broken view != dead server
+            try:
+                self._reply_json(500, {"error": str(exc)[:200]})
+            except Exception:
+                pass
+
+    # -- POST --------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        try:
+            if not path.startswith(_SCORE_PREFIX):
+                self._reply_json(404, {"error": "not found", "path": path})
+                return
+            model = path[len(_SCORE_PREFIX):].strip("/")
+            code, doc, raw, extra = self.frontend.score(
+                model, self.headers, self._read_body())
+            if raw is not None:
+                self._reply(code, raw, "application/octet-stream", extra)
+            else:
+                self._reply_json(code, doc, extra)
+        except _BadRequest as exc:
+            self._reply_json(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — a broken request != dead server
+            try:
+                self._reply_json(500, {"error": str(exc)[:200]})
+            except Exception:
+                pass
+
+    def _read_body(self) -> bytes:
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            raise _BadRequest("bad Content-Length") from None
+        if n <= 0:
+            raise _BadRequest("empty request body")
+        if n > _MAX_BODY:
+            raise _BadRequest(f"body over the {_MAX_BODY} byte cap")
+        return self.rfile.read(n)
+
+
+class ScoringFrontend:
+    """HTTP scoring endpoint over a ServingService's admission plane."""
+
+    def __init__(self, service, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        self.service = service
+        self.admission = service.admission
+        if self.admission is None:
+            raise ValueError(
+                "ScoringFrontend needs the service's admission "
+                "controller (built when tpu_serve_port or tpu_serve_qos "
+                "is set)")
+        handler = type("_BoundHandler", (_Handler,), {"frontend": self})
+        # stock TCPServer listens with backlog 5 — a thundering herd of
+        # fresh client connections (the bench and CI overload legs open
+        # dozens at once) gets connection resets at accept time
+        server_cls = type("_FrontServer", (ThreadingHTTPServer,),
+                          {"daemon_threads": True,
+                           "request_queue_size": 128})
+        self._server = server_cls((host, int(port)), handler)
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self.requests_by_code: Dict[int, int] = {}
+        self._count_lock = threading.Lock()
+        from ...obs import metrics as obs_metrics
+        self._metrics = (obs_metrics.serving_instruments()
+                         if obs_metrics.enabled() else None)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"lgbt-serve-frontend:{self.port}")
+        self._thread.start()
+        log.event("serve_frontend", state="started", host=host,
+                  port=self.port, qos=dict(self.admission.qos),
+                  shed=self.admission.shed_enabled)
+
+    # -- request path ------------------------------------------------------
+    def _count(self, code: int) -> None:
+        with self._count_lock:
+            self.requests_by_code[code] = \
+                self.requests_by_code.get(code, 0) + 1
+        if self._metrics is not None:
+            self._metrics.http_requests.labels(code=str(code)).inc()
+
+    def score(self, model: str, headers, body: bytes
+              ) -> Tuple[int, Optional[Dict[str, Any]],
+                         Optional[bytes], Optional[Dict[str, str]]]:
+        """One scoring request, already read off the wire. Returns
+        (status, json_doc, raw_body, extra_headers) — exactly one of
+        json_doc/raw_body is non-None. Raises _BadRequest for anything
+        malformed, BEFORE the admission/coalescer layers see it."""
+        if not model:
+            raise _BadRequest("no model name in /v1/score/<model>")
+        ctype = (headers.get("Content-Type") or "application/json")
+        ctype = ctype.split(";", 1)[0].strip().lower()
+        if ctype == "application/octet-stream":
+            X = _parse_binary_rows(body, headers)
+        else:
+            X = _parse_json_rows(body)
+        entry = self.service.registry.get(model)
+        if entry is None:
+            return 404, {"error": f"model {model!r} not resident",
+                         "models": self.service.registry.names()}, \
+                None, None
+        if X.shape[1] != entry.num_features:
+            raise _BadRequest(
+                f"model {model!r} scores {entry.num_features} features "
+                f"per row, got {X.shape[1]}")
+        deadline_ms = None
+        raw_dl = headers.get("X-Deadline-Ms")
+        if raw_dl is not None:
+            try:
+                deadline_ms = float(raw_dl)
+            except ValueError:
+                raise _BadRequest(
+                    f"X-Deadline-Ms is not a number: {raw_dl!r}") \
+                    from None
+            if deadline_ms <= 0:
+                raise _BadRequest("X-Deadline-Ms must be positive")
+        try:
+            fut = self.admission.submit(model, X, deadline_ms=deadline_ms)
+        except ShedError as exc:
+            return 429, {"error": "shed", "model": model,
+                         "qos": exc.qos,
+                         "burn_rate": round(exc.burn_rate, 4)}, \
+                None, {"Retry-After": "1"}
+        except RuntimeError as exc:    # admission closed: shutting down
+            return 503, {"error": str(exc)}, None, None
+        wait_s = (deadline_ms / 1e3 + 5.0 if deadline_ms
+                  else _DEFAULT_WAIT_S)
+        try:
+            margins = fut.result(timeout=wait_s)
+        except DeadlineExpired as exc:
+            return 504, {"error": "deadline expired", "model": model,
+                         "deadline_ms": exc.deadline_ms,
+                         "waited_ms": round(exc.waited_ms, 3)}, \
+                None, None
+        except KeyError as exc:        # evicted between check and flush
+            return 404, {"error": str(exc)}, None, None
+        except Exception as exc:  # noqa: BLE001 — engine/coalescer error
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}, \
+                None, None
+        margins = np.asarray(margins)
+        accept = (headers.get("Accept") or "").lower()
+        if "application/octet-stream" in accept:
+            shape = ",".join(str(d) for d in margins.shape)
+            return 200, None, \
+                np.ascontiguousarray(margins, "<f4").tobytes(), \
+                {"X-Shape": shape}
+        return 200, {"model": model, "rows": int(X.shape[0]),
+                     "predictions": margins.tolist()}, None, None
+
+    # -- views -------------------------------------------------------------
+    def render_healthz(self) -> Dict[str, Any]:
+        svc = self.service
+        doc: Dict[str, Any] = {
+            "schema": 1,
+            "status": "ok",
+            "models": svc.registry.names(),
+            "qos": {m: QOS_NAMES[p]
+                    for m, p in sorted(self.admission.qos.items())},
+            "shedding": sorted(self.admission.shedding()),
+            "admission": self.admission.stats(),
+            "devices": 1,
+            "replicas": {},
+        }
+        if svc.placer is not None:
+            pstats = svc.placer.stats()
+            doc["devices"] = pstats["devices"]
+            doc["replicas"] = {n: len(reps) for n, reps
+                               in pstats["models"].items()}
+            doc["placement"] = pstats
+        return doc
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+        with self._count_lock:
+            totals = dict(self.requests_by_code)
+        log.event("serve_frontend", state="stopped", port=self.port,
+                  requests_by_code={str(k): v
+                                    for k, v in sorted(totals.items())})
+
+    def __enter__(self) -> "ScoringFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,358 @@
+"""Multi-device model placement: HBM-headroom assignment, hot-model
+replication, shallowest-queue routing.
+
+The registry (serving/registry.py) treats the accelerator as one pool:
+every ForestEngine lands on the default device and one global budget
+drives LRU eviction. A multi-chip serving host wastes N-1 devices that
+way. The `Placer` turns the pool per-device:
+
+* **assignment** — each loaded model's forest is pinned
+  (`ForestEngine.to_device`) on the device with the most HBM headroom:
+  real backend `memory_stats()` where the platform reports them, else
+  the configured per-device budget minus the bytes this placer already
+  placed (the emulated-device / CPU case, where `obs/memory`'s
+  accountant has no per-device counters to offer);
+* **replication** — models are request-rate ranked; the hottest get
+  replicas (engine clones pinned to other devices, warmed off the
+  routing path) up to `tpu_serve_replicas`, filling free headroom only
+  — a copy is an optimization and never evicts someone else's primary;
+* **routing** — the coalescer asks `route()` per batch and gets the
+  replica with the shallowest queue (pending rows), so a slow device
+  backs itself off; per-device depth is exported as the
+  `serve_device_queue_rows{device}` gauge;
+* **per-device LRU budget** — `tpu_serve_hbm_budget_mb` becomes a
+  per-device ceiling: placing a primary on a full device evicts that
+  device's least-recently-routed replicas (`serve_place` events with
+  reason="evict"), never the whole-registry LRU sweep. The service
+  disables the registry's global budget when a placer is attached so
+  the two policies cannot fight.
+
+A hot swap replaces the registry entry's engine object; `route()`
+detects the stale replica set by identity and re-places lazily — no
+watcher integration needed, the first post-swap batch repins.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ...utils import locks, log
+
+__all__ = ["Placer", "Replica", "resolve_devices"]
+
+# routing calls between hot-model replication checks: rare enough to
+# stay off the hot path, frequent enough that a traffic shift
+# replicates within a few hundred batches
+_REBALANCE_EVERY = 64
+# request-rate counters are halved this often (in routing calls) so the
+# "hot" ranking tracks current traffic, not lifetime totals
+_RATE_DECAY_EVERY = 1024
+
+
+def resolve_devices(count: int) -> list:
+    """The device list `tpu_serve_devices` names: 0 = all visible,
+    N = the first N (clamped)."""
+    import jax
+    devs = list(jax.devices())
+    if count > 0:
+        devs = devs[:count]
+    return devs
+
+
+class Replica:
+    """One device-resident copy of a model's forest."""
+
+    __slots__ = ("model", "engine", "device_index", "bytes",
+                 "pending_rows", "primary")
+
+    def __init__(self, model: str, engine, device_index: int,
+                 primary: bool) -> None:
+        self.model = model
+        self.engine = engine
+        self.device_index = device_index
+        self.bytes = int(engine.device_bytes())
+        self.pending_rows = 0
+        self.primary = primary
+
+
+@locks.guarded
+class Placer:
+    """Per-device replica pool over the registry's entries."""
+
+    def __init__(self, registry, devices: Optional[list] = None,
+                 budget_mb: float = 0.0, max_replicas: int = 2,
+                 warm_rows: int = 256, tracer=None) -> None:
+        self.registry = registry
+        self.devices = list(devices if devices is not None
+                            else resolve_devices(0))
+        self.budget_bytes = int(max(float(budget_mb), 0.0) * 2 ** 20)
+        self.max_replicas = max(int(max_replicas), 1)
+        self.warm_rows = int(warm_rows)
+        self._tracer = tracer
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, List[Replica]] = {}   # guarded-by: _lock
+        # the entry engine each model's replica set was derived from;
+        # a swap installs a new engine object and the identity mismatch
+        # triggers lazy re-placement on the next route
+        self._src: Dict[str, Any] = {}                  # guarded-by: _lock
+        self._rate: Dict[str, int] = {}                 # guarded-by: _lock
+        self._routes = 0                                # guarded-by: _lock
+        self._tick = 0                                  # guarded-by: _lock
+        self._last_used: Dict[tuple, int] = {}          # guarded-by: _lock
+        # (model, device) pairs that already announced serve_route
+        self._routed_pairs: set = set()                 # guarded-by: _lock
+        self._replicating: set = set()                  # guarded-by: _lock
+        self.placements = 0
+        self.replications = 0
+        self.evictions = 0
+        from ...obs import metrics as obs_metrics
+        self._metrics = (obs_metrics.serving_instruments()
+                         if obs_metrics.enabled() else None)
+
+    # -- notes -------------------------------------------------------------
+    def _note(self, kind: str, **fields) -> None:
+        log.event(kind, **fields)  # graftlint: disable=LGT005 kinds are caller literals, validated at runtime
+        if self._tracer is not None:
+            self._tracer.note(kind, **fields)
+
+    # -- accounting --------------------------------------------------------
+    def _used_bytes(self, dev_i: int) -> int:  # guarded-by: caller
+        return sum(r.bytes for reps in self._replicas.values()
+                   for r in reps if r.device_index == dev_i)
+
+    def _headroom(self, dev_i: int) -> float:  # guarded-by: caller
+        """Free HBM on a device: real backend stats when the platform
+        reports them, else the configured budget minus placed bytes,
+        else placed bytes negated (pure load balancing)."""
+        if self.budget_bytes > 0:
+            return float(self.budget_bytes - self._used_bytes(dev_i))
+        try:
+            stats = self.devices[dev_i].memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_limit" in stats:
+            return float(stats["bytes_limit"]
+                         - stats.get("bytes_in_use", 0))
+        return -float(self._used_bytes(dev_i))
+
+    def _touch(self, model: str, dev_i: int) -> None:  # guarded-by: caller
+        self._tick += 1
+        self._last_used[(model, dev_i)] = self._tick
+
+    def _gauge_depth(self, dev_i: int) -> None:  # guarded-by: caller
+        if self._metrics is not None:
+            depth = sum(r.pending_rows
+                        for reps in self._replicas.values()
+                        for r in reps if r.device_index == dev_i)
+            self._metrics.device_queue.labels(device=str(dev_i)).set(depth)
+
+    # -- placement ---------------------------------------------------------
+    def _evict_for(self, dev_i: int, need: int,
+                   protect: str) -> None:  # guarded-by: caller
+        """Per-device LRU: drop least-recently-routed replicas on
+        `dev_i` until `need` bytes fit the budget; `protect`'s replicas
+        are exempt. Over-budget with nothing evictable degrades to the
+        registry's serve_over_budget discipline: place anyway, warn."""
+        if self.budget_bytes <= 0:
+            return
+        while self._used_bytes(dev_i) + need > self.budget_bytes:
+            victims = [r for reps in self._replicas.values() for r in reps
+                       if r.device_index == dev_i and r.model != protect]
+            if not victims:
+                log.event("serve_over_budget", model=protect, bytes=need,
+                          budget=self.budget_bytes, device=dev_i)
+                return
+            victim = min(victims, key=lambda r: self._last_used.get(
+                (r.model, r.device_index), 0))
+            self._drop(victim, reason="evict")
+            self.evictions += 1
+
+    def _drop(self, rep: Replica, reason: str) -> None:  # guarded-by: caller
+        reps = self._replicas.get(rep.model, [])
+        if rep in reps:
+            reps.remove(rep)
+        if not reps:
+            self._replicas.pop(rep.model, None)
+            self._src.pop(rep.model, None)
+        self._last_used.pop((rep.model, rep.device_index), None)
+        self._routed_pairs.discard((rep.model, rep.device_index))
+        self._note("serve_place", model=rep.model,
+                   device=rep.device_index, bytes=rep.bytes,
+                   reason=reason, replicas=len(reps))
+        if self._metrics is not None:
+            self._metrics.replicas.labels(model=rep.model).set(len(reps))
+        self._gauge_depth(rep.device_index)
+
+    def place(self, name: str, entry) -> Replica:
+        """Pin a (re)loaded entry's engine on the device with the most
+        headroom; replaces any existing replica set for the name."""
+        with self._lock:
+            for rep in list(self._replicas.get(name, [])):
+                self._drop(rep, reason="replace")
+            need = int(entry.engine.device_bytes())
+            dev_i = max(range(len(self.devices)),
+                        key=lambda i: (self._headroom(i), -i))
+            self._evict_for(dev_i, need, protect=name)
+            if len(self.devices) > 1:
+                entry.engine.to_device(self.devices[dev_i])
+            rep = Replica(name, entry.engine, dev_i, primary=True)
+            self._replicas[name] = [rep]
+            self._src[name] = entry.engine
+            self._touch(name, dev_i)
+            self.placements += 1
+            self._note("serve_place", model=name, device=dev_i,
+                       bytes=rep.bytes, reason="load", replicas=1)
+            if self._metrics is not None:
+                self._metrics.replicas.labels(model=name).set(1)
+            # a hosting device exposes its queue gauge from placement
+            # on (depth 0), not from its first routed batch
+            self._gauge_depth(dev_i)
+            return rep
+
+    # -- replication -------------------------------------------------------
+    def _clone_engine(self, entry, device):
+        """A second engine over the same trees, pinned to `device` and
+        warmed there. Built OFF the placer lock — compiles must not
+        stall routing."""
+        from ...serve.engine import ForestEngine
+        import numpy as np
+        src = entry.engine
+        eng = ForestEngine(src.trees, num_class=entry.num_class,
+                           mode=src.mode, compact=src.compact)
+        eng.to_device(device)
+        rows = min(max(self.warm_rows, 1), eng.chunk_rows)
+        eng.predict(np.zeros((rows, entry.num_features), np.float64))
+        return eng
+
+    def _replicate(self, name: str) -> None:
+        """Add one replica of `name` on the best device not already
+        hosting it, headroom permitting. Runs on a short-lived daemon
+        thread; `_replicating` keeps it single-flight per model."""
+        try:
+            entry = self.registry.get(name)
+            with self._lock:
+                reps = self._replicas.get(name)
+                if (entry is None or reps is None
+                        or self._src.get(name) is not entry.engine
+                        or len(reps) >= self.max_replicas):
+                    return
+                hosted = {r.device_index for r in reps}
+                free = [i for i in range(len(self.devices))
+                        if i not in hosted]
+                need = int(entry.engine.device_bytes())
+                free = [i for i in free
+                        if self.budget_bytes <= 0
+                        or self._used_bytes(i) + need <= self.budget_bytes]
+                if not free:
+                    return
+                dev_i = max(free, key=lambda i: (self._headroom(i), -i))
+            eng = self._clone_engine(entry, self.devices[dev_i])
+            with self._lock:
+                reps = self._replicas.get(name)
+                if reps is None or self._src.get(name) is not entry.engine:
+                    return      # swapped/evicted while we compiled
+                rep = Replica(name, eng, dev_i, primary=False)
+                reps.append(rep)
+                self._touch(name, dev_i)
+                self.replications += 1
+                self._note("serve_place", model=name, device=dev_i,
+                           bytes=rep.bytes, reason="replicate",
+                           replicas=len(reps))
+                if self._metrics is not None:
+                    self._metrics.replicas.labels(
+                        model=name).set(len(reps))
+                self._gauge_depth(dev_i)
+        finally:
+            with self._lock:
+                self._replicating.discard(name)
+
+    def _maybe_replicate(self) -> None:  # guarded-by: caller
+        """Kick async replication for the hottest under-replicated
+        model (request-rate ranked)."""
+        if len(self.devices) < 2 or self.max_replicas < 2:
+            return
+        for name, _n in sorted(self._rate.items(),
+                               key=lambda kv: -kv[1]):
+            reps = self._replicas.get(name)
+            if (reps is None or len(reps) >= self.max_replicas
+                    or name in self._replicating):
+                continue
+            self._replicating.add(name)
+            threading.Thread(target=self._replicate, args=(name,),
+                             daemon=True,
+                             name=f"lgbt-serve-replicate-{name}").start()
+            return
+
+    def rebalance(self) -> None:
+        """Force one replication check synchronously (tests and the
+        bench call this instead of waiting for the route-count
+        trigger); any spawned clone still builds on its own thread."""
+        with self._lock:
+            self._maybe_replicate()
+
+    # -- routing -----------------------------------------------------------
+    def route(self, name: str, entry, rows: int) -> Replica:
+        """The replica this batch should run on: shallowest pending-row
+        queue, ties to the lower device. Re-places lazily after a swap
+        (new engine object) and on first sight of a model the service
+        never announced."""
+        with self._lock:
+            reps = self._replicas.get(name)
+            if reps is None or self._src.get(name) is not entry.engine:
+                # first sight or post-swap: (re)place under the same
+                # lock hold so a concurrent eviction can't race the
+                # fresh set away before we pick from it
+                self.place(name, entry)
+                reps = self._replicas[name]
+            rep = min(reps, key=lambda r: (r.pending_rows, r.device_index))
+            rep.pending_rows += rows
+            self._rate[name] = self._rate.get(name, 0) + 1
+            self._routes += 1
+            self._touch(name, rep.device_index)
+            pair = (name, rep.device_index)
+            if pair not in self._routed_pairs:
+                self._routed_pairs.add(pair)
+                self._note("serve_route", model=name,
+                           device=rep.device_index,
+                           primary=rep.primary, replicas=len(reps))
+            if self._routes % _RATE_DECAY_EVERY == 0:
+                for k in list(self._rate):
+                    self._rate[k] //= 2
+            if self._routes % _REBALANCE_EVERY == 0:
+                self._maybe_replicate()
+            self._gauge_depth(rep.device_index)
+            return rep
+
+    def done(self, rep: Replica, rows: int) -> None:
+        """Batch finished on `rep`: release its queue depth."""
+        with self._lock:
+            rep.pending_rows = max(rep.pending_rows - rows, 0)
+            self._gauge_depth(rep.device_index)
+
+    # -- views -------------------------------------------------------------
+    def replica_count(self, name: str) -> int:
+        with self._lock:
+            return len(self._replicas.get(name, []))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "devices": len(self.devices),
+                "budget_bytes_per_device": self.budget_bytes,
+                "placements": self.placements,
+                "replications": self.replications,
+                "evictions": self.evictions,
+                "models": {
+                    n: [{"device": r.device_index, "bytes": r.bytes,
+                         "pending_rows": r.pending_rows,
+                         "primary": r.primary} for r in reps]
+                    for n, reps in self._replicas.items()},
+                "device_used_bytes": {
+                    str(i): self._used_bytes(i)
+                    for i in range(len(self.devices))},
+                "device_queue_rows": {
+                    str(i): sum(r.pending_rows
+                                for reps in self._replicas.values()
+                                for r in reps if r.device_index == i)
+                    for i in range(len(self.devices))},
+            }

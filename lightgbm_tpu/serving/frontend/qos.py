@@ -1,0 +1,361 @@
+"""QoS admission control: priority queues + load shedding in front of
+the coalescer.
+
+The coalescer (serving/coalescer.py) is FIFO per model — every caller
+is equal. A network front door is not: a checkout-scoring model and a
+batch-backfill job share the same host, and under saturation the
+cheap traffic must not crowd out the important traffic. This module is
+that policy layer:
+
+* `parse_qos` maps `tpu_serve_qos="model:class,..."` to per-model
+  priority classes — gold (0, highest), silver (1), bronze (2).
+  A `default:` item classes unlisted models; otherwise they are bronze.
+* `AdmissionController.submit` enqueues into per-class priority queues;
+  a dispatcher thread forwards whole requests (never split — the
+  coalescer's contract is preserved) in strict class order while the
+  in-flight row window (`tpu_serve_admit_rows`) has room. Under
+  saturation gold dispatches first, always.
+* per-request deadlines (`X-Deadline-Ms`): a request still queued when
+  its budget expires is answered with `DeadlineExpired` WITHOUT an
+  engine dispatch — scoring it anyway would waste a bucket on an
+  answer nobody is waiting for.
+* load shedding: when a model's rolling SLO burn rate
+  (`RequestTracer.burn_rates`, obs/reqtrace.py) rises to
+  `tpu_serve_shed_high`, requests below gold for that model are
+  rejected instantly with `ShedError` (the front door maps it to a
+  fast 429) until the rate falls back to `tpu_serve_shed_low` —
+  hysteresis, so a rate hovering at the watermark doesn't flap.
+  Gold is NEVER shed: shedding exists to protect it.
+
+Zero new threads per request: one dispatcher thread per controller,
+futures end to end.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...utils import locks, log
+
+__all__ = ["QOS_CLASSES", "QOS_NAMES", "parse_qos", "qos_class",
+           "ShedError", "DeadlineExpired", "AdmissionController"]
+
+# class name -> priority (0 dispatches first and is never shed)
+QOS_CLASSES: Dict[str, int] = {"gold": 0, "silver": 1, "bronze": 2}
+QOS_NAMES: Tuple[str, ...] = ("gold", "silver", "bronze")
+_DEFAULT_CLASS = QOS_CLASSES["bronze"]
+
+# how often (seconds) the shed state re-reads the tracer's burn rates;
+# between refreshes admission decisions use the cached state, so the
+# per-request cost of shedding is one dict lookup
+_SHED_REFRESH_S = 0.05
+
+
+class ShedError(RuntimeError):
+    """Request rejected by load shedding (front door answers 429)."""
+
+    def __init__(self, model: str, qos: str, burn_rate: float) -> None:
+        super().__init__(
+            f"model {model!r} is shedding {qos} traffic "
+            f"(burn_rate={burn_rate:.3f})")
+        self.model = model
+        self.qos = qos
+        self.burn_rate = burn_rate
+
+
+class DeadlineExpired(TimeoutError):
+    """Request deadline elapsed before dispatch (front door: 504)."""
+
+    def __init__(self, model: str, deadline_ms: float,
+                 waited_ms: float) -> None:
+        super().__init__(
+            f"request for {model!r} expired its {deadline_ms:g}ms "
+            f"deadline after {waited_ms:.1f}ms in the admission queue")
+        self.model = model
+        self.deadline_ms = deadline_ms
+        self.waited_ms = waited_ms
+
+
+def parse_qos(spec: str) -> Dict[str, int]:
+    """``"ctr:gold,backfill:bronze,default:silver"`` -> name->priority.
+    Classes are names or their numeric priorities (0/1/2); the
+    ``default`` key classes models not listed. Raises ValueError on a
+    malformed item — config validation calls this at startup so a typo
+    fails fast, not on the first live request."""
+    out: Dict[str, int] = {}
+    for item in (s.strip() for s in spec.split(",") if s.strip()):
+        if ":" not in item:
+            raise ValueError(
+                f"tpu_serve_qos item {item!r} is not 'model:class'")
+        name, cls = (t.strip() for t in item.rsplit(":", 1))
+        cls = cls.lower()
+        if cls in QOS_CLASSES:
+            pri = QOS_CLASSES[cls]
+        elif cls.isdigit() and int(cls) < len(QOS_NAMES):
+            pri = int(cls)
+        else:
+            raise ValueError(
+                f"tpu_serve_qos class {cls!r} for {name!r} is not one "
+                f"of {'/'.join(QOS_NAMES)} or 0..{len(QOS_NAMES) - 1}")
+        if not name:
+            raise ValueError(f"tpu_serve_qos item {item!r} has no model")
+        out[name] = pri
+    return out
+
+
+def qos_class(qos: Dict[str, int], model: str) -> int:
+    """A model's priority under the map (the `default` entry, then
+    bronze, for unlisted models)."""
+    pri = qos.get(model)
+    if pri is None:
+        pri = qos.get("default", _DEFAULT_CLASS)
+    return pri
+
+
+class _Pending:
+    __slots__ = ("model", "X", "rows", "pri", "deadline_s", "t_submit",
+                 "future")
+
+    def __init__(self, model: str, X, pri: int,
+                 deadline_ms: Optional[float]) -> None:
+        self.model = model
+        self.X = X
+        self.rows = int(X.shape[0])
+        self.pri = pri
+        self.t_submit = time.perf_counter()
+        self.deadline_s = (None if not deadline_ms
+                           else self.t_submit + float(deadline_ms) / 1e3)
+        self.future: Future = Future()
+
+
+@locks.guarded
+class AdmissionController:
+    """Priority queues + shedding between the front door and the
+    coalescer. `submit` is the only client entry point; everything it
+    returns or raises is a policy decision made BEFORE the coalescer
+    sees the request."""
+
+    def __init__(self, coalescer, qos: Optional[Dict[str, int]] = None,
+                 tracer=None, window_rows: int = 0,
+                 shed: str = "auto", shed_high: float = 0.5,
+                 shed_low: float = 0.25) -> None:
+        self.coalescer = coalescer
+        self.qos = dict(qos or {})
+        self._tracer = tracer
+        self.window_rows = (int(window_rows) if window_rows > 0
+                            else 2 * coalescer.max_batch_rows)
+        # shed=auto: shedding is live exactly when its signal is — the
+        # tracer computes burn rates only when an SLO is configured
+        self.shed_enabled = (shed == "on" or (
+            shed == "auto" and tracer is not None
+            and getattr(tracer, "slo_ms", 0) > 0))
+        self.shed_high = float(shed_high)
+        self.shed_low = float(shed_low)
+        self._cv = threading.Condition()
+        self._queues: List[deque] = [deque()
+                                     for _ in QOS_NAMES]  # guarded-by: _cv
+        self._inflight_rows = 0                           # guarded-by: _cv
+        self._closed = False                              # guarded-by: _cv
+        # shed state: model -> burn rate at trip time; refreshed from
+        # the tracer at most every _SHED_REFRESH_S
+        self._shedding: Dict[str, float] = {}             # guarded-by: _cv
+        self._shed_checked = 0.0                          # guarded-by: _cv
+        self.requests = 0
+        self.dispatched = 0
+        self.sheds = 0
+        self.sheds_by_class = [0] * len(QOS_NAMES)
+        self.deadline_expired = 0
+        self._deadline_logged = 0.0                       # guarded-by: _cv
+        from ...obs import metrics as obs_metrics
+        self._metrics = (obs_metrics.serving_instruments()
+                         if obs_metrics.enabled() else None)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lgbt-serve-admission")
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, model: str, X,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request. Raises ShedError immediately when the
+        model is shedding this request's class; otherwise returns a
+        Future that resolves to raw margins, DeadlineExpired, or the
+        coalescer's error."""
+        pri = qos_class(self.qos, model)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("admission controller is closed")
+            self._refresh_shed_state(time.perf_counter())
+            burn = self._shedding.get(model)
+            if burn is not None and pri > 0:
+                self.sheds += 1
+                self.sheds_by_class[pri] += 1
+                shed_exc = ShedError(model, QOS_NAMES[pri], burn)
+            else:
+                shed_exc = None
+                self.requests += 1
+                req = _Pending(model, X, pri, deadline_ms)
+                self._queues[pri].append(req)
+                self._cv.notify()
+        if shed_exc is not None:
+            if self._metrics is not None:
+                self._metrics.shed.labels(
+                    model=model, qos=QOS_NAMES[pri]).inc()
+            raise shed_exc
+        if self._metrics is not None:
+            self._metrics.admit_depth.labels(
+                qos=QOS_NAMES[pri]).set(len(self._queues[pri]))
+        return req.future
+
+    def shedding(self) -> Dict[str, float]:
+        """Models currently shedding -> burn rate at trip (live view for
+        /healthz and tests)."""
+        with self._cv:
+            self._refresh_shed_state(time.perf_counter())
+            return dict(self._shedding)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "requests": self.requests,
+                "dispatched": self.dispatched,
+                "sheds": self.sheds,
+                "sheds_by_class": {QOS_NAMES[i]: n
+                                   for i, n in
+                                   enumerate(self.sheds_by_class) if n},
+                "deadline_expired": self.deadline_expired,
+                "queued": {QOS_NAMES[i]: len(q)
+                           for i, q in enumerate(self._queues) if q},
+                "inflight_rows": self._inflight_rows,
+                "window_rows": self.window_rows,
+                "shed_enabled": self.shed_enabled,
+                "shedding": dict(self._shedding),
+            }
+
+    def close(self) -> None:
+        """Stop the dispatcher; queued requests fail fast."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            for q in self._queues:
+                for req in q:
+                    req.future.set_exception(
+                        RuntimeError("admission controller closed"))
+                q.clear()
+            self._cv.notify()
+        self._thread.join(timeout=30)
+
+    # -- shed hysteresis ---------------------------------------------------
+    def _refresh_shed_state(self, now: float) -> None:  # guarded-by: caller
+        """Re-read burn rates and flip per-model shed state with
+        hysteresis; rate-limited so admission stays O(1) per request."""
+        if not self.shed_enabled or self._tracer is None:
+            return
+        if now - self._shed_checked < _SHED_REFRESH_S:
+            return
+        self._shed_checked = now
+        try:
+            rates = self._tracer.burn_rates()
+        except Exception:   # tracer mid-close must not kill admission
+            return
+        for model, rate in rates.items():
+            tripped = model in self._shedding
+            if not tripped and rate >= self.shed_high:
+                self._shedding[model] = float(rate)
+                log.event("serve_shed", model=model, state="on",
+                          burn_rate=round(float(rate), 4),
+                          high=self.shed_high, low=self.shed_low,
+                          sheds=self.sheds)
+            elif tripped and rate <= self.shed_low:
+                del self._shedding[model]
+                log.event("serve_shed", model=model, state="off",
+                          burn_rate=round(float(rate), 4),
+                          high=self.shed_high, low=self.shed_low,
+                          sheds=self.sheds)
+
+    # -- dispatcher thread -------------------------------------------------
+    def _pop(self, now: float):  # guarded-by: caller
+        """Next dispatchable request, strict class order; expired
+        requests anywhere in the queues are answered (without dispatch)
+        on the way. None when every queue is empty."""
+        for pri, q in enumerate(self._queues):
+            while q:
+                req = q.popleft()
+                if req.deadline_s is not None and now > req.deadline_s:
+                    self._expire(req, now)
+                    continue
+                if self._metrics is not None:
+                    self._metrics.admit_depth.labels(
+                        qos=QOS_NAMES[pri]).set(len(q))
+                return req
+        return None
+
+    def _expire_overdue(self, now: float) -> None:  # guarded-by: caller
+        """Expire deadline-passed requests while the window is
+        saturated — a full window must not pin a doomed request in the
+        queue past its budget (`_pop` only runs when there is room)."""
+        for q in self._queues:
+            overdue = [r for r in q if r.deadline_s is not None
+                       and now > r.deadline_s]
+            for req in overdue:
+                q.remove(req)
+                self._expire(req, now)
+
+    def _expire(self, req: _Pending, now: float) -> None:  # guarded-by: caller
+        self.deadline_expired += 1
+        waited_ms = (now - req.t_submit) * 1e3
+        deadline_ms = (req.deadline_s - req.t_submit) * 1e3
+        if now - self._deadline_logged > 1.0:   # rate-limited event
+            self._deadline_logged = now
+            log.event("serve_deadline", model=req.model,
+                      deadline_ms=round(deadline_ms, 3),
+                      waited_ms=round(waited_ms, 3),
+                      expired_total=self.deadline_expired)
+        if self._metrics is not None:
+            self._metrics.deadline_expired.labels(model=req.model).inc()
+        req.future.set_exception(
+            DeadlineExpired(req.model, deadline_ms, waited_ms))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                now = time.perf_counter()
+                req = None
+                if self._inflight_rows < self.window_rows:
+                    req = self._pop(now)
+                else:
+                    self._expire_overdue(now)
+                if req is None:
+                    if self._closed:
+                        return
+                    # bounded wait so queued deadlines expire on time
+                    # even when the window is saturated or traffic stops
+                    self._cv.wait(timeout=0.01)
+                    continue
+                self._inflight_rows += req.rows
+            try:
+                inner = self.coalescer.submit(req.model, req.X)
+            except BaseException as exc:  # noqa: BLE001 — via the future
+                with self._cv:
+                    self._inflight_rows -= req.rows
+                    self._cv.notify()
+                req.future.set_exception(exc)
+                continue
+            with self._cv:
+                self.dispatched += 1
+            inner.add_done_callback(
+                lambda f, r=req: self._finish(r, f))
+
+    def _finish(self, req: _Pending, inner: Future) -> None:
+        """Coalescer resolved: release the window, mirror the outcome."""
+        with self._cv:
+            self._inflight_rows -= req.rows
+            self._cv.notify()
+        exc = inner.exception()
+        if exc is not None:
+            req.future.set_exception(exc)
+        else:
+            req.future.set_result(inner.result())

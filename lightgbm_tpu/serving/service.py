@@ -55,18 +55,54 @@ class ServingService:
                 sample=cfg.tpu_serve_trace_sample,
                 ring_size=cfg.tpu_serve_trace_ring,
                 out_dir=cfg.tpu_serve_trace_dir)
+        # multi-device placer (frontend/placement.py): with more than
+        # one device the HBM budget becomes PER-DEVICE and the placer's
+        # per-device LRU replaces the registry's global sweep — both
+        # enforcing at once would fight over the same bytes
+        self.placer = None
+        place_on = cfg.tpu_serve_devices != 1
+        if place_on:
+            from .frontend.placement import Placer, resolve_devices
+            place_devices = resolve_devices(cfg.tpu_serve_devices)
+            place_on = len(place_devices) > 1
         self.registry = ModelRegistry(
-            hbm_budget_mb=cfg.tpu_serve_hbm_budget_mb,
+            hbm_budget_mb=(0.0 if place_on
+                           else cfg.tpu_serve_hbm_budget_mb),
             warm_rows=cfg.tpu_serve_warm_rows,
             ledger=ledger, tracer=self.tracer,
             compact=cfg.tpu_serve_compact,
             compact_tol=cfg.tpu_serve_compact_tol,
             aot_dir=cfg.tpu_serve_aot_dir)
+        if place_on:
+            self.placer = Placer(self.registry, devices=place_devices,
+                                 budget_mb=cfg.tpu_serve_hbm_budget_mb,
+                                 max_replicas=cfg.tpu_serve_replicas,
+                                 warm_rows=cfg.tpu_serve_warm_rows,
+                                 tracer=self.tracer)
         self.coalescer = RequestCoalescer(
             self.registry,
             max_batch_wait_ms=cfg.tpu_serve_max_batch_wait_ms,
             max_batch_rows=cfg.tpu_serve_max_batch_rows,
-            tracer=self.tracer)
+            tracer=self.tracer, placer=self.placer)
+        # QoS admission + network front door (frontend/): built when a
+        # front-door port or a QoS map asks for them; in-process
+        # predict()/predict_async() stay direct-to-coalescer
+        self.admission = None
+        self.frontend = None
+        if cfg.tpu_serve_port or cfg.tpu_serve_qos:
+            from .frontend.qos import AdmissionController, parse_qos
+            self.admission = AdmissionController(
+                self.coalescer,
+                qos=parse_qos(cfg.tpu_serve_qos),
+                tracer=self.tracer,
+                window_rows=cfg.tpu_serve_admit_rows,
+                shed=cfg.tpu_serve_shed,
+                shed_high=cfg.tpu_serve_shed_high,
+                shed_low=cfg.tpu_serve_shed_low)
+        if cfg.tpu_serve_port:
+            from .frontend.http import ScoringFrontend
+            self.frontend = ScoringFrontend(self,
+                                            port=cfg.tpu_serve_port)
         if cfg.tpu_serve_metrics_port:
             from .exporter import MetricsExporter
             # /debug/timeline merges whatever file-backed trace streams
@@ -86,9 +122,14 @@ class ServingService:
     def load_model(self, name: str, model_str: Optional[str] = None,
                    model_file: Optional[str] = None,
                    checkpoint_dir: Optional[str] = None) -> ModelEntry:
-        return self.registry.load(name, model_str=model_str,
-                                  model_file=model_file,
-                                  checkpoint_dir=checkpoint_dir)
+        entry = self.registry.load(name, model_str=model_str,
+                                   model_file=model_file,
+                                   checkpoint_dir=checkpoint_dir)
+        if self.placer is not None:
+            # watcher swaps skip this path; route() re-places lazily on
+            # the first post-swap batch (engine identity check)
+            self.placer.place(name, entry)
+        return entry
 
     def watch(self, name: str, checkpoint_dir: str) -> CheckpointWatcher:
         """Serve `name` from a checkpoint directory and keep it current:
@@ -125,12 +166,28 @@ class ServingService:
             out["reqtrace"] = self.tracer.totals()
         if self.exporter is not None:
             out["metrics_endpoint"] = self.exporter.url
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
+        if self.placer is not None:
+            out["placement"] = self.placer.stats()
+        if self.frontend is not None:
+            out["frontend"] = {
+                "url": self.frontend.url,
+                "requests_by_code": {
+                    str(k): v for k, v in
+                    sorted(self.frontend.requests_by_code.items())}}
         return out
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        # stop accepting from the wire first, then drain inward:
+        # frontend -> admission -> watchers -> coalescer
+        if self.frontend is not None:
+            self.frontend.close()
+        if self.admission is not None:
+            self.admission.close()
         for w in self._watchers.values():
             w.stop()
         # coalescer drains before the tracer closes, so every in-flight

@@ -12,19 +12,30 @@ watcher poll can never observe a torn model — which means the existing
 ``serving.watcher`` hot-swaps the refreshed fleet live with no new
 serving-side code.
 
-``RefreshTrigger`` closes the observe->retrain edge of the loop: it
-watches the per-model ``serve_slo_burn_rate`` signal the request tracer
-aggregates (obs/reqtrace.py) and enqueues models whose burn rate
-crosses the high watermark into the next refresh fleet, emitting a
-``sweep_refresh_triggered`` event per enqueue. ``refresh_due`` drains
-the queue into a ``refresh_many`` call covering only the burning
-members.
+``RefreshTrigger`` closes the observe->retrain edge of the loop on two
+signals. The LATENCY signal: it watches the per-model
+``serve_slo_burn_rate`` the request tracer aggregates (obs/reqtrace.py)
+and enqueues models whose burn rate crosses the high watermark. The
+QUALITY signal: fed a held-out reference window per model
+(``set_reference``) and the live scores the serving plane emits
+(``observe_scores``), it tracks the drift between the live score
+distribution and the reference — quantile-profile distance, scale-free
+— and enqueues a model whose drift stays above ``drift_threshold`` for
+``drift_sustain`` consecutive full windows (sustained, so one odd
+batch never triggers a retrain). Both paths emit one
+``sweep_refresh_triggered`` event per enqueue, tagged with
+``reason="slo_burn"`` or ``reason="score_drift"``. ``refresh_due``
+drains the queue into a ``refresh_many`` call covering only the
+enqueued members.
 """
 from __future__ import annotations
 
 import json
 import os
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
 
 from ..basic import Booster, Dataset, LightGBMError
 from ..utils import log
@@ -46,14 +57,32 @@ class RefreshTrigger:
     ``sweep_refresh_triggered`` event. ``drain`` hands the due fleet
     indices to the next refresh cycle."""
 
+    # live-score window sizing: drift is judged over the most recent
+    # _DRIFT_WINDOW scores, and only once at least _DRIFT_MIN_N have
+    # arrived (matching the burn window's warm-up discipline)
+    _DRIFT_WINDOW = 256
+    _DRIFT_MIN_N = 64
+    # quantile grid the live/reference score profiles are compared on
+    _DRIFT_QUANTS = np.linspace(0.05, 0.95, 19)
+
     def __init__(self, models: Sequence[str],
-                 threshold: Optional[float] = None) -> None:
+                 threshold: Optional[float] = None,
+                 drift_threshold: float = 0.0,
+                 drift_sustain: int = 3) -> None:
         from ..obs.reqtrace import SLO_BURN_HIGH
         self.models = list(models)
         self.threshold = float(SLO_BURN_HIGH if threshold is None
                                else threshold)
         self._index = {name: i for i, name in enumerate(self.models)}
         self._due: Dict[int, float] = {}
+        # score-drift detection (0 disables): per-model reference
+        # quantile profile + rolling live window + consecutive-hot count
+        self.drift_threshold = float(drift_threshold)
+        self.drift_sustain = max(int(drift_sustain), 1)
+        self._ref_q: Dict[str, np.ndarray] = {}
+        self._ref_scale: Dict[str, float] = {}
+        self._live: Dict[str, deque] = {}
+        self._hot: Dict[str, int] = {}
 
     def observe(self, burn_rates: Dict[str, float]) -> List[int]:
         """Ingest one burn-rate snapshot; returns newly-enqueued fleet
@@ -66,9 +95,67 @@ class RefreshTrigger:
             self._due[i] = float(rate)
             fresh.append(i)
             log.event("sweep_refresh_triggered", model=name, index=i,
+                      reason="slo_burn",
                       burn_rate=round(float(rate), 4),
                       threshold=self.threshold)
         return fresh
+
+    # -- score drift -------------------------------------------------------
+    def set_reference(self, name: str, scores) -> None:
+        """Install a model's held-out reference window: the raw-margin
+        distribution its live traffic is expected to follow (typically
+        the model's scores over a held-out validation slice at deploy
+        time). Resets any live window collected so far."""
+        s = np.asarray(scores, np.float64).reshape(-1)
+        if s.size < 2:
+            raise ValueError(
+                f"reference window for {name!r} needs >= 2 scores")
+        self._ref_q[name] = np.quantile(s, self._DRIFT_QUANTS)
+        # scale-free drift: quantile gaps are normalized by the
+        # reference spread so one threshold works across objectives
+        self._ref_scale[name] = max(float(np.std(s)), 1e-12)
+        self._live[name] = deque(maxlen=self._DRIFT_WINDOW)
+        self._hot[name] = 0
+
+    def drift_of(self, name: str) -> Optional[float]:
+        """Current live-vs-reference drift (mean quantile distance over
+        the rolling window, in reference-spread units); None before the
+        window warms up or without a reference."""
+        ref = self._ref_q.get(name)
+        live = self._live.get(name)
+        if ref is None or live is None or len(live) < self._DRIFT_MIN_N:
+            return None
+        lq = np.quantile(np.asarray(live, np.float64),
+                         self._DRIFT_QUANTS)
+        return float(np.mean(np.abs(lq - ref)) / self._ref_scale[name])
+
+    def observe_scores(self, name: str, scores) -> bool:
+        """Feed live scores (raw margins) for one model; returns True
+        when this observation enqueued it. Sustained drift — above
+        ``drift_threshold`` on ``drift_sustain`` consecutive full-window
+        observations — triggers; a single hot window never does."""
+        if self.drift_threshold <= 0 or name not in self._ref_q:
+            return False
+        i = self._index.get(name)
+        if i is None:
+            return False
+        self._live[name].extend(
+            np.asarray(scores, np.float64).reshape(-1).tolist())
+        drift = self.drift_of(name)
+        if drift is None:
+            return False
+        if drift < self.drift_threshold:
+            self._hot[name] = 0
+            return False
+        self._hot[name] += 1
+        if self._hot[name] < self.drift_sustain or i in self._due:
+            return False
+        self._due[i] = float(drift)
+        log.event("sweep_refresh_triggered", model=name, index=i,
+                  reason="score_drift", drift=round(drift, 4),
+                  threshold=self.drift_threshold,
+                  sustained=self._hot[name])
+        return True
 
     def poll(self, tracer) -> List[int]:
         """``observe`` straight off a live ``RequestTracer``."""
@@ -78,9 +165,12 @@ class RefreshTrigger:
         return sorted(self._due)
 
     def drain(self) -> List[int]:
-        """Pop the queue (re-arming every drained member)."""
+        """Pop the queue (re-arming every drained member — including
+        the drift counters, so a refreshed model must drift anew)."""
         out = sorted(self._due)
         self._due.clear()
+        for name in self._hot:
+            self._hot[name] = 0
         return out
 
 

@@ -1,0 +1,470 @@
+"""Network front door (lightgbm_tpu.serving.frontend): QoS parsing,
+admission priority under saturation, shed hysteresis, deadline expiry
+without dispatch, HTTP endpoint contracts (malformed bodies never reach
+the coalescer), and multi-device placement/routing over the emulated
+device mesh (conftest forces 8 virtual CPU devices).
+"""
+import http.client
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import ServingService
+from lightgbm_tpu.serving.frontend import (AdmissionController,
+                                           DeadlineExpired, Placer,
+                                           ScoringFrontend, ShedError,
+                                           parse_qos, qos_class)
+from lightgbm_tpu.utils.log import (parse_event, register_callback,
+                                    set_verbosity)
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
+          "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _data(seed=0, n=400, f=8):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + 0.3 * rng.rand(n) > 0.6).astype(np.float64)
+    return X, y
+
+
+def _booster(seed=0, rounds=8):
+    X, y = _data(seed)
+    p = dict(PARAMS, seed=seed)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds), X
+
+
+@pytest.fixture
+def events():
+    lines = []
+    register_callback(lines.append)
+    set_verbosity(1)
+    yield lambda kind: [r for r in map(parse_event, lines)
+                        if r and r["event"] == kind]
+    register_callback(None)
+    set_verbosity(1)
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -------------------------------------------------------------- qos map
+
+def test_parse_qos_names_numbers_default():
+    qos = parse_qos("ctr:gold, backfill:bronze ,exp:1,default:silver")
+    assert qos == {"ctr": 0, "backfill": 2, "exp": 1, "default": 1}
+    assert qos_class(qos, "ctr") == 0
+    assert qos_class(qos, "unlisted") == 1          # the default entry
+    assert qos_class({}, "unlisted") == 2           # bronze fallback
+    assert parse_qos("") == {}
+
+
+@pytest.mark.parametrize("spec", ["ctr", "ctr:platinum", ":gold",
+                                  "ctr:9"])
+def test_parse_qos_malformed_raises(spec):
+    with pytest.raises(ValueError):
+        parse_qos(spec)
+
+
+def test_config_validates_qos_at_startup():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(Exception):
+        Config.from_params({"tpu_serve_qos": "ctr:platinum"})
+    cfg = Config.from_params({"tpu_serve_qos": "ctr:gold"})
+    assert cfg.tpu_serve_qos == "ctr:gold"
+
+
+# -------------------------------------------- admission under saturation
+
+class _FakeCoalescer:
+    """Records submit order; futures resolve only when the test says."""
+
+    def __init__(self, max_batch_rows=64):
+        self.max_batch_rows = max_batch_rows
+        self.submitted = []
+        self.futures = []
+        self._lock = threading.Lock()
+
+    def submit(self, model, X):
+        fut = Future()
+        with self._lock:
+            self.submitted.append(model)
+            self.futures.append(fut)
+        return fut
+
+
+class _FakeTracer:
+    slo_ms = 5.0
+
+    def __init__(self):
+        self.rates = {}
+
+    def burn_rates(self):
+        return dict(self.rates)
+
+
+def test_priority_ordering_under_saturation():
+    """With the in-flight window saturated, a queued gold request must
+    dispatch before bronze requests that arrived earlier."""
+    co = _FakeCoalescer()
+    ac = AdmissionController(co, qos={"g": 0, "b": 2}, window_rows=16)
+    try:
+        X16 = np.zeros((16, 4))
+        blocker = ac.submit("b", X16)           # fills the window
+        _wait_for(lambda: len(co.submitted) == 1, what="first dispatch")
+        b1 = ac.submit("b", X16)                # queued behind the window
+        b2 = ac.submit("b", X16)
+        g = ac.submit("g", X16)                 # arrives LAST
+        time.sleep(0.1)
+        assert len(co.submitted) == 1           # window still saturated
+        co.futures[0].set_result(np.zeros(16))  # free the window
+        _wait_for(lambda: len(co.submitted) >= 2, what="second dispatch")
+        assert co.submitted[1] == "g", co.submitted
+
+        def drain():
+            # each resolution frees the window for the next dispatch,
+            # which mints a new inner future to resolve in turn
+            for fut in list(co.futures):
+                if not fut.done():
+                    fut.set_result(np.zeros(16))
+            return len(co.submitted) == 4
+        _wait_for(drain, what="queue drain")
+        assert co.submitted == ["b", "g", "b", "b"]
+        for f in (blocker, b1, b2, g):
+            assert f.result(timeout=5).shape == (16,)
+    finally:
+        ac.close()
+
+
+def test_shed_hysteresis_raise_and_clear(events):
+    """Shedding trips at shed_high, HOLDS between low and high, clears
+    only at/below shed_low; gold is never shed."""
+    co = _FakeCoalescer()
+    tr = _FakeTracer()
+    ac = AdmissionController(co, qos={"gold_m": 0}, tracer=tr,
+                             shed="on", shed_high=0.5, shed_low=0.25)
+    try:
+        X = np.zeros((4, 4))
+        tr.rates = {"m": 0.9, "gold_m": 0.9}
+        time.sleep(0.06)                  # past the shed refresh limit
+        with pytest.raises(ShedError) as ei:
+            ac.submit("m", X)
+        assert ei.value.model == "m" and ei.value.qos == "bronze"
+        ac.submit("gold_m", X)            # gold passes while shedding
+        assert "m" in ac.shedding()
+
+        tr.rates = {"m": 0.3, "gold_m": 0.3}   # between low and high
+        time.sleep(0.06)
+        with pytest.raises(ShedError):
+            ac.submit("m", X)             # hysteresis: still shedding
+
+        tr.rates = {"m": 0.1, "gold_m": 0.1}
+        time.sleep(0.06)
+        assert ac.shedding() == {}        # cleared below shed_low
+        ac.submit("m", X)
+        st = ac.stats()
+        assert st["sheds"] == 2
+        assert st["sheds_by_class"] == {"bronze": 2}
+        assert "gold" not in st["sheds_by_class"]
+        # gold_m also trips shed STATE (its burn is high too) — the
+        # class check just never rejects its traffic; assert per model
+        on = [e for e in events("serve_shed")
+              if e["state"] == "on" and e["model"] == "m"]
+        off = [e for e in events("serve_shed")
+               if e["state"] == "off" and e["model"] == "m"]
+        assert len(on) == 1 and len(off) == 1
+    finally:
+        ac.close()
+
+
+def test_deadline_expired_without_dispatch(events):
+    """A request still queued when its deadline passes is answered with
+    DeadlineExpired and NEVER reaches the coalescer."""
+    co = _FakeCoalescer()
+    ac = AdmissionController(co, qos={}, window_rows=16)
+    try:
+        X16 = np.zeros((16, 4))
+        blocker = ac.submit("m", X16)     # saturates the window forever
+        _wait_for(lambda: len(co.submitted) == 1, what="first dispatch")
+        fut = ac.submit("m", np.zeros((4, 4)), deadline_ms=30)
+        with pytest.raises(DeadlineExpired) as ei:
+            fut.result(timeout=5)
+        assert ei.value.deadline_ms == pytest.approx(30.0)
+        assert ei.value.waited_ms >= 30.0
+        assert len(co.submitted) == 1     # expired request never dispatched
+        assert ac.stats()["deadline_expired"] == 1
+        assert events("serve_deadline")
+        co.futures[0].set_result(np.zeros(16))
+        blocker.result(timeout=5)
+    finally:
+        ac.close()
+
+
+# --------------------------------------------------------- HTTP endpoint
+
+def _post(port, model, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", f"/v1/score/{model}", body=body,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def http_svc():
+    bst, X = _booster()
+    svc = ServingService(params={"tpu_serve_qos": "m:gold",
+                                 "tpu_serve_max_batch_wait_ms": 1.0})
+    svc.load_model("m", model_str=bst.model_to_string())
+    fe = ScoringFrontend(svc, port=0)
+    yield svc, fe, bst, X
+    fe.close()
+    svc.close()
+
+
+def test_http_scoring_parity_json_and_binary(http_svc):
+    svc, fe, bst, X = http_svc
+    rows = X[:13]
+    want = bst.predict(rows, raw_score=True)
+
+    body = json.dumps({"rows": rows.tolist()}).encode()
+    status, data, _ = _post(fe.port, "m", body)
+    assert status == 200
+    doc = json.loads(data)
+    assert doc["model"] == "m" and doc["rows"] == 13
+    np.testing.assert_allclose(doc["predictions"], want, rtol=1e-6)
+
+    raw = rows.astype("<f8").tobytes()
+    status, data, hdrs = _post(
+        fe.port, "m", raw,
+        headers={"Content-Type": "application/octet-stream",
+                 "X-Num-Features": str(rows.shape[1]), "X-Dtype": "f64",
+                 "Accept": "application/octet-stream"})
+    assert status == 200
+    got = np.frombuffer(data, "<f4")
+    assert hdrs["X-Shape"] == "13"
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_http_malformed_never_reaches_coalescer(http_svc):
+    svc, fe, bst, X = http_svc
+    before_admit = svc.admission.stats()["requests"]
+    before_co = svc.coalescer.stats()["requests"]
+    nf = X.shape[1]
+    bad = [
+        (b"{not json", {}),                              # invalid JSON
+        (json.dumps({"rows": []}).encode(), {}),         # empty rows
+        (json.dumps({"rows": [[1, 2], [3]]}).encode(), {}),  # ragged
+        (json.dumps({"rows": [[0.1] * (nf + 3)]}).encode(), {}),  # width
+        (b"", {}),                                       # empty body
+        (b"\x00" * 7,                                    # torn binary row
+         {"Content-Type": "application/octet-stream",
+          "X-Num-Features": str(nf)}),
+        (b"\x00" * 4 * nf,                               # no row count hdr
+         {"Content-Type": "application/octet-stream"}),
+        (json.dumps({"rows": [[0.1] * nf]}).encode(),    # bad deadline
+         {"X-Deadline-Ms": "soon"}),
+        (json.dumps({"rows": [[0.1] * nf]}).encode(),
+         {"X-Deadline-Ms": "-5"}),
+    ]
+    for body, hdrs in bad:
+        status, data, _ = _post(fe.port, "m", body, headers=hdrs)
+        assert status == 400, (status, data, hdrs)
+        assert b"error" in data
+    # a 400 is decided at the front door: admission and coalescer
+    # counters must not have moved
+    assert svc.admission.stats()["requests"] == before_admit
+    assert svc.coalescer.stats()["requests"] == before_co
+    assert fe.requests_by_code.get(400) == len(bad)
+
+
+def test_http_unknown_model_404_and_healthz(http_svc):
+    svc, fe, bst, X = http_svc
+    body = json.dumps({"rows": X[:2].tolist()}).encode()
+    status, data, _ = _post(fe.port, "ghost", body)
+    assert status == 404
+    assert "m" in json.loads(data)["models"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=60)
+    try:
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+    finally:
+        conn.close()
+    assert resp.status == 200
+    assert doc["schema"] == 1 and doc["status"] == "ok"
+    assert doc["models"] == ["m"]
+    assert doc["qos"] == {"m": "gold"}
+    assert doc["shedding"] == []
+    assert doc["devices"] >= 1
+    assert "admission" in doc
+
+
+# ----------------------------------------------- placement and routing
+
+@pytest.fixture
+def placed_svc():
+    """4 emulated devices, a per-device budget sized to ~2 small
+    forests, replication allowed."""
+    boosters = [_booster(seed=s)[0] for s in range(3)]
+    svc = ServingService(params={
+        "tpu_serve_devices": 4,
+        "tpu_serve_replicas": 2,
+        "tpu_serve_max_batch_wait_ms": 1.0,
+        "tpu_serve_warm_rows": 64,
+    })
+    assert svc.placer is not None
+    for i, bst in enumerate(boosters):
+        svc.load_model(f"m{i}", model_str=bst.model_to_string())
+    yield svc
+    svc.close()
+
+
+def test_placer_spreads_and_replicates_hot_model(placed_svc, events):
+    svc = placed_svc
+    X = np.random.RandomState(0).rand(8, 8)
+    st = svc.placer.stats()
+    assert st["devices"] == 4
+    assert st["placements"] == 3
+    assert set(st["models"]) == {"m0", "m1", "m2"}
+    # headroom assignment with no budget = pure load balancing: three
+    # equal-size primaries land on three DIFFERENT devices
+    primary_devs = [reps[0]["device"] for reps in st["models"].values()]
+    assert len(set(primary_devs)) == 3
+
+    # make m1 hot, then force a replication check; the clone compiles
+    # on its own thread so poll for the second replica
+    for _ in range(20):
+        svc.predict("m1", X, timeout=60)
+    svc.placer.rebalance()
+    _wait_for(lambda: svc.placer.replica_count("m1") >= 2,
+              what="hot-model replica")
+    st = svc.placer.stats()
+    devs = {r["device"] for r in st["models"]["m1"]}
+    assert len(devs) == 2                  # replicas on distinct devices
+    assert st["replications"] >= 1
+    # replica traffic still answers correctly
+    for _ in range(8):
+        svc.predict("m1", X, timeout=60)
+    assert [e for e in events("serve_place")
+            if e["reason"] == "replicate" and e["model"] == "m1"]
+    assert [e for e in events("serve_route") if e["model"] == "m1"]
+
+
+def test_placer_routes_to_shallowest_queue(placed_svc):
+    svc = placed_svc
+    entry = svc.registry.acquire("m0")
+    placer = svc.placer
+    r1 = placer.route("m0", entry, rows=100)
+    # first replica now has 100 pending rows; clone a second replica by
+    # hand so routing has a choice
+    placer._replicating.add("m0")
+    placer._replicate("m0")
+    assert placer.replica_count("m0") == 2
+    r2 = placer.route("m0", entry, rows=10)
+    assert r2 is not r1                    # shallower queue wins
+    assert r2.device_index != r1.device_index
+    placer.done(r1, 100)
+    r3 = placer.route("m0", entry, rows=1)
+    assert r3 is r1                        # drained queue wins again
+    st = placer.stats()
+    assert sum(st["device_queue_rows"].values()) == 11
+    placer.done(r2, 10)
+    placer.done(r3, 1)
+    assert sum(placer.stats()["device_queue_rows"].values()) == 0
+
+
+def test_placer_per_device_budget_evicts_lru(events):
+    """A per-device budget that fits ~1.5 forests forces the second
+    placement onto another device and eviction once all are full."""
+    boosters = [_booster(seed=s)[0] for s in range(3)]
+    texts = [b.model_to_string() for b in boosters]
+    set_verbosity(1)       # training at verbosity=-1 silenced events
+    svc = ServingService(params={
+        "tpu_serve_devices": 2,
+        "tpu_serve_replicas": 1,
+        "tpu_serve_max_batch_wait_ms": 1.0,
+        "tpu_serve_warm_rows": 64,
+    })
+    try:
+        svc.load_model("m0", model_str=texts[0])
+        one = svc.registry.acquire("m0").engine.device_bytes()
+        # rebuild with a budget sized off the real engine bytes
+        svc.close()
+        svc = ServingService(params={
+            "tpu_serve_devices": 2,
+            "tpu_serve_replicas": 1,
+            "tpu_serve_hbm_budget_mb": one * 1.5 / 2 ** 20,
+            "tpu_serve_max_batch_wait_ms": 1.0,
+            "tpu_serve_warm_rows": 64,
+        })
+        # the registry's global budget must be OFF when the placer owns
+        # per-device budgets — the two must never fight
+        assert svc.registry.hbm_budget_bytes == 0
+        svc.load_model("m0", model_str=texts[0])
+        svc.load_model("m1", model_str=texts[1])
+        st = svc.placer.stats()
+        d0, d1 = (st["models"]["m0"][0]["device"],
+                  st["models"]["m1"][0]["device"])
+        assert d0 != d1                    # second forest avoids full dev
+        assert st["evictions"] == 0
+        svc.load_model("m2", model_str=texts[2])   # both devices full now
+        st = svc.placer.stats()
+        assert st["evictions"] == 1
+        assert "m2" in st["models"]
+        evicted = {"m0", "m1"} - set(st["models"])
+        assert len(evicted) == 1
+        ev = [e for e in events("serve_place") if e["reason"] == "evict"]
+        assert len(ev) == 1 and ev[0]["model"] in evicted
+        for i in range(2):
+            assert st["device_used_bytes"][str(i)] <= \
+                st["budget_bytes_per_device"]
+    finally:
+        svc.close()
+
+
+def test_placer_replaces_after_hot_swap(placed_svc, events):
+    """A registry swap installs a new engine object; the next routed
+    batch must re-place (engine identity check) and keep answering."""
+    svc = placed_svc
+    X = np.random.RandomState(1).rand(4, 8)
+    before = svc.predict("m0", X, timeout=60)
+    placements0 = svc.placer.stats()["placements"]
+    v2, _ = _booster(seed=77, rounds=12)
+    svc.registry.swap("m0", v2.model_to_string(), version="v2",
+                      source="test")
+    after = svc.predict("m0", X, timeout=60)     # routes -> re-places
+    assert svc.placer.stats()["placements"] == placements0 + 1
+    np.testing.assert_allclose(after, v2.predict(X, raw_score=True),
+                               rtol=1e-6)
+    assert not np.allclose(before, after)
+    reps = svc.placer.stats()["models"]["m0"]
+    assert len(reps) == 1 and reps[0]["primary"]
+
+
+def test_frontend_requires_admission():
+    bst, _ = _booster()
+    svc = ServingService()                 # no qos, no port -> no admission
+    try:
+        assert svc.admission is None
+        with pytest.raises(ValueError):
+            ScoringFrontend(svc, port=0)
+    finally:
+        svc.close()

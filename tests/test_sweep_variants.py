@@ -298,3 +298,52 @@ def test_refresh_trigger_poll_from_tracer():
     trig = RefreshTrigger(["m0", "m1"])   # default SLO_BURN_HIGH = 0.5
     assert trig.poll(FakeTracer()) == [0]
     assert trig.due() == [0]
+
+
+def test_refresh_trigger_score_drift_sustained():
+    """In-distribution live scores never trigger; a shifted
+    distribution triggers exactly once after `drift_sustain`
+    consecutive hot windows."""
+    rng = np.random.RandomState(7)
+    ref = rng.randn(2000)
+    trig = RefreshTrigger(["m0", "m1"], drift_threshold=1.0,
+                          drift_sustain=2)
+    trig.set_reference("m0", ref)
+
+    # same distribution: warmed-up drift stays far under threshold
+    for _ in range(6):
+        assert not trig.observe_scores("m0", rng.randn(128))
+    assert trig.drift_of("m0") < 0.3
+    assert trig.due() == []
+
+    # shifted scores: first hot observation arms, second enqueues, and
+    # further hot windows don't re-trigger (edge behavior)
+    fired = [trig.observe_scores("m0", rng.randn(256) + 3.0)
+             for _ in range(4)]
+    assert fired == [False, True, False, False]
+    assert trig.due() == [0]
+    assert trig.drift_of("m0") > 2.0
+
+    # drained members re-arm, including the sustain counter
+    assert trig.drain() == [0]
+    fired = [trig.observe_scores("m0", rng.randn(256) + 3.0)
+             for _ in range(2)]
+    assert fired == [False, True]
+
+
+def test_refresh_trigger_score_drift_guards():
+    trig = RefreshTrigger(["m0"], drift_threshold=1.0)
+    # no reference installed / unknown model: observe is a no-op
+    assert not trig.observe_scores("m0", [1.0, 2.0])
+    assert not trig.observe_scores("ghost", [1.0, 2.0])
+    assert trig.drift_of("m0") is None
+    with pytest.raises(ValueError):
+        trig.set_reference("m0", [1.0])      # needs >= 2 scores
+    trig.set_reference("m0", np.zeros(100))
+    # below the warm-up count the window never judges
+    assert not trig.observe_scores("m0", np.ones(8) * 50)
+    assert trig.drift_of("m0") is None
+    # threshold 0 disables the drift path entirely
+    off = RefreshTrigger(["m0"], drift_threshold=0.0)
+    off.set_reference("m0", np.zeros(100))
+    assert not off.observe_scores("m0", np.ones(256) * 50)

@@ -77,6 +77,12 @@ DIRECTION: Dict[str, int] = {
     "predict_speedup": +1,
     "warm_speedup": +1,
     "coalesced_vs_direct": +1,
+    # front-door socket legs (serving/frontend/): the same coalescing
+    # win measured at the wire, plus N-client socket tail latency
+    "http_vs_direct": +1,
+    "http_direct_rows_s": +1,
+    "http_coalesced_rows_s": +1,
+    "http_p99_ms": -1,
     "mslr_rank_fused_speedup": +1,
     "sweep_models_per_s_m8": +1,     # batched fleet throughput
     "sweep_models_per_s_m32": +1,
@@ -122,6 +128,10 @@ METRIC_STAGE = {
     "ndcg10": "mslr", "mslr_rank_fused_speedup": "mslr",
     "predict_speedup": "predict",
     "coalesced_vs_direct": "serve_traffic",
+    "http_vs_direct": "serve_traffic",
+    "http_direct_rows_s": "serve_traffic",
+    "http_coalesced_rows_s": "serve_traffic",
+    "http_p99_ms": "serve_traffic",
     "valid_overhead_pct": "valid_overhead",
     "warm_speedup": "warm_rerun",
     "auc_ours_1m_100it": "ref_parity",

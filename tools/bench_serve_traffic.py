@@ -19,6 +19,13 @@ current backend:
 * **hot-swap under load**: client threads keep scoring model 0 while a
   retrained version is `registry.swap`ped in; asserts ZERO failed
   requests and that post-swap predictions changed to the new model.
+* **front-door socket legs** (serving/frontend/): the same traffic
+  through a real `POST /v1/score/<model>` socket — 1-client vs N-client
+  closed loops (`http_vs_direct` is the coalescing win measured at the
+  wire), an open-loop HTTP QPS sweep with p50/p99, a swap-under-load
+  leg asserting zero non-200s, and a shed-under-overload leg against a
+  deliberately-unmeetable SLO asserting that load shedding trips
+  (shed ratio recorded) and that gold traffic is NEVER shed.
 
 Importable as `run(...)` (bench.py's serve_traffic stage and the CI
 smoke both call it) or a CLI:
@@ -28,6 +35,7 @@ smoke both call it) or a CLI:
 Env overrides: BENCH_SMOKE=1 (tiny sizes), BENCH_SERVE_QPS (comma list),
 BENCH_SERVE_SECS, BENCH_SERVE_CLIENTS, BENCH_SERVE_MODELS.
 """
+import http.client
 import json
 import os
 import sys
@@ -171,6 +179,311 @@ def _hot_swap_under_load(svc, name, v2_text, reqs, clients, secs):
             "version_after": svc.registry.acquire(name).version}
 
 
+# -- front-door socket legs (serving/frontend/) ---------------------------
+
+def _http_post(conn, model, body, headers=None):
+    """One scoring POST on a keep-alive connection; returns
+    (status, decoded-json-or-None). Reconnects on a dropped socket."""
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
+    for attempt in (0, 1):
+        try:
+            conn.request("POST", f"/v1/score/{model}", body=body,
+                         headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, (json.loads(data) if data else None)
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            if attempt:
+                raise
+    raise RuntimeError("unreachable")
+
+
+def _http_closed_loop(port, names, bodies, clients, secs):
+    """`clients` threads, one keep-alive connection each, POST as fast
+    as completions allow. Returns (done, codes{status: n}, wall_s,
+    latencies_s)."""
+    stop = time.perf_counter() + secs
+    codes = {}
+    lats = [[] for _ in range(clients)]
+    lock = threading.Lock()
+
+    def worker(ci):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        i = ci
+        try:
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                status, _ = _http_post(conn, names[i % len(names)],
+                                       bodies[i % len(bodies)])
+                lats[ci].append(time.perf_counter() - t0)
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+                i += 1
+        finally:
+            conn.close()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = [v for ls in lats for v in ls]
+    return len(flat), codes, wall, flat
+
+
+def _http_open_loop(port, names, bodies, qps, secs, workers):
+    """Open loop at the wire: request i is DUE at t_start + i/qps and a
+    worker pool posts it as soon as it can; latency is measured from
+    the scheduled arrival, so pool/queue delay shows up in p99 exactly
+    as a real late answer would."""
+    interval = 1.0 / qps
+    n_target = max(int(qps * secs), 1)
+    idx = [0]
+    fails = [0]
+    lats = []
+    lock = threading.Lock()
+    t_start = time.perf_counter()
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            while True:
+                with lock:
+                    i = idx[0]
+                    if i >= n_target:
+                        return
+                    idx[0] += 1
+                due = t_start + i * interval
+                now = time.perf_counter()
+                if due > now:
+                    time.sleep(due - now)
+                status, _ = _http_post(conn, names[i % len(names)],
+                                       bodies[i % len(bodies)])
+                end = time.perf_counter()
+                with lock:
+                    if status == 200:
+                        lats.append(end - due)
+                    else:
+                        fails[0] += 1
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    p50, p99 = _percentiles(lats)
+    return {"qps_target": qps,
+            "qps_achieved": round(n_target / wall, 1),
+            "requests": n_target, "failures": fails[0],
+            "p50_ms": p50, "p99_ms": p99}
+
+
+def _http_swap_under_load(svc, port, name, v2_text, bodies, clients,
+                          secs):
+    """Threaded POSTs on `name` while a retrained version swaps in;
+    every response through the live swap must be a 200."""
+    stop_at = time.perf_counter() + secs
+    codes = {}
+    lock = threading.Lock()
+
+    def worker(ci):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        i = ci
+        try:
+            while time.perf_counter() < stop_at:
+                status, _ = _http_post(conn, name, bodies[i % len(bodies)])
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+                i += 1
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(secs * 0.3)            # traffic established mid-flight
+    t0 = time.perf_counter()
+    svc.registry.swap(name, v2_text, version="v2",
+                      source="traffic-bench-http")
+    swap_s = time.perf_counter() - t0
+    for t in threads:
+        t.join()
+    ok = codes.get(200, 0)
+    bad = sum(n for c, n in codes.items() if c != 200)
+    return {"requests_ok": ok, "requests_failed": bad,
+            "swap_s": round(swap_s, 3),
+            "version_after": svc.registry.acquire(name).version}
+
+
+def _http_shed_leg(texts, bodies, clients, secs, say):
+    """Overload a bronze model against an unmeetable SLO (every request
+    breaches 0.05ms, so its burn rate saturates) while gold traffic
+    rides along; sheds must trip for bronze and NEVER for gold."""
+    from lightgbm_tpu.serving import ServingService
+    from lightgbm_tpu.serving.frontend import ScoringFrontend
+
+    svc = ServingService(params={
+        "tpu_serve_max_batch_wait_ms": 1.0,
+        "tpu_serve_max_batch_rows": 2048,
+        "tpu_serve_warm_rows": 256,
+        "tpu_serve_trace": True,
+        "tpu_serve_slo_ms": 0.05,
+        "tpu_serve_qos": "gold_m:gold,bulk_m:bronze",
+    })
+    try:
+        svc.load_model("gold_m", model_str=texts[0])
+        svc.load_model("bulk_m", model_str=texts[-1])
+        fe = ScoringFrontend(svc, port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                              timeout=120)
+            # warm the burn window past _BURN_MIN_N finished outcomes
+            # (every one breaches the 0.05ms SLO), then let the 50ms
+            # shed-state refresh observe the saturated rate
+            for i in range(24):
+                _http_post(conn, "bulk_m", bodies[i % len(bodies)])
+            conn.close()
+            time.sleep(0.1)
+
+            codes = {"gold_m": {}, "bulk_m": {}}
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + max(secs, 1.0)
+
+            def worker(ci, model):
+                c = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                               timeout=120)
+                i = ci
+                try:
+                    while time.perf_counter() < stop_at:
+                        status, _ = _http_post(c, model,
+                                               bodies[i % len(bodies)])
+                        with lock:
+                            codes[model][status] = \
+                                codes[model].get(status, 0) + 1
+                        i += 1
+                finally:
+                    c.close()
+
+            threads = ([threading.Thread(target=worker,
+                                         args=(c, "bulk_m"))
+                        for c in range(max(clients - 2, 2))]
+                       + [threading.Thread(target=worker,
+                                           args=(c, "gold_m"))
+                          for c in range(2)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            astats = svc.admission.stats()
+        finally:
+            fe.close()
+    finally:
+        svc.close()
+
+    bulk_ok = codes["bulk_m"].get(200, 0)
+    bulk_shed = codes["bulk_m"].get(429, 0)
+    gold_shed = codes["gold_m"].get(429, 0)
+    rec = {
+        "sheds": astats["sheds"],
+        "sheds_by_class": astats["sheds_by_class"],
+        "bulk_ok": bulk_ok, "bulk_shed_429": bulk_shed,
+        "gold_ok": codes["gold_m"].get(200, 0),
+        "gold_shed_429": gold_shed,
+        "shed_ratio": round(bulk_shed / max(bulk_ok + bulk_shed, 1), 4),
+    }
+    say(f"http shed: {rec}")
+    # the leg's whole point: overload sheds SOME bronze traffic and
+    # ZERO gold traffic — gold starvation would be a policy bug
+    assert rec["sheds"] > 0 and bulk_shed > 0, rec
+    assert gold_shed == 0, rec
+    assert "gold" not in astats["sheds_by_class"], rec
+    return rec
+
+
+def _frontdoor_legs(texts, v2_text, reqs, rows_per_req, clients, secs,
+                    qps_list, wait_ms, max_batch_rows, say):
+    """All four socket legs; returns the http_* record fields."""
+    from lightgbm_tpu.serving import ServingService
+    from lightgbm_tpu.serving.frontend import ScoringFrontend
+
+    bodies = [json.dumps({"rows": r.tolist()}).encode()
+              for r in reqs[:16]]
+    names = [f"m{i}" for i in range(len(texts))]
+    svc = ServingService(params={
+        "tpu_serve_max_batch_wait_ms": wait_ms,
+        "tpu_serve_max_batch_rows": max_batch_rows,
+        "tpu_serve_warm_rows": 256,
+        "tpu_serve_qos": f"{names[0]}:gold,default:bronze",
+    })
+    try:
+        for name, text in zip(names, texts):
+            svc.load_model(name, model_str=text)
+        for name in names:
+            svc.registry.acquire(name).warm(512)
+        fe = ScoringFrontend(svc, port=0)
+        try:
+            # single-request socket baseline: one request in flight at
+            # a time means the coalescer can never merge anything
+            n_dir, codes_dir, wall_dir, _ = _http_closed_loop(
+                fe.port, names, bodies, 1, secs)
+            direct_rows_s = n_dir * rows_per_req / wall_dir
+            say(f"http direct (1 client): {n_dir} reqs in "
+                f"{wall_dir:.2f}s ({direct_rows_s:,.0f} rows/s)")
+
+            n_co, codes_co, wall_co, lat_co = _http_closed_loop(
+                fe.port, names, bodies, clients, secs)
+            coalesced_rows_s = n_co * rows_per_req / wall_co
+            say(f"http coalesced ({clients} clients): {n_co} reqs in "
+                f"{wall_co:.2f}s ({coalesced_rows_s:,.0f} rows/s)")
+
+            sweep = []
+            for qps in qps_list:
+                rec = _http_open_loop(fe.port, names, bodies, qps, secs,
+                                      workers=clients)
+                say(f"http open loop qps={qps}: "
+                    f"achieved={rec['qps_achieved']} "
+                    f"p50={rec['p50_ms']}ms p99={rec['p99_ms']}ms "
+                    f"failures={rec['failures']}")
+                sweep.append(rec)
+
+            swap = _http_swap_under_load(svc, fe.port, names[0], v2_text,
+                                         bodies, clients, max(secs, 1.0))
+            say(f"http hot swap: {swap}")
+            assert swap["requests_failed"] == 0, swap
+            assert swap["version_after"] == "v2", swap
+        finally:
+            fe.close()
+    finally:
+        svc.close()
+
+    shed = _http_shed_leg(texts, bodies, clients, secs, say)
+    p50, p99 = _percentiles(lat_co)
+    fails = sum(n for c, n in list(codes_dir.items())
+                + list(codes_co.items()) if c != 200)
+    return {
+        "http_direct_rows_s": round(direct_rows_s, 1),
+        "http_coalesced_rows_s": round(coalesced_rows_s, 1),
+        "http_vs_direct": round(
+            coalesced_rows_s / max(direct_rows_s, 1e-9), 2),
+        "http_p50_ms": p50, "http_p99_ms": p99,
+        "http_closed_failures": fails,
+        "http_qps_sweep": sweep,
+        "http_swap": swap,
+        "http_shed": shed,
+        "http_shed_ratio": shed["shed_ratio"],
+    }
+
+
 def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
         open_secs: float = 2.0, closed_secs: float = 2.0, clients: int = 32,
         train_rows: int = 8000, train_rounds: int = 60,
@@ -178,7 +491,7 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
         max_batch_rows: int = 2048, hbm_budget_mb: float = 0.0,
         seed: int = 0, ledger=None, verbose: bool = False,
         trace_dir=None, trace_sample: float = 1.0,
-        slo_ms: float = 0.0) -> dict:
+        slo_ms: float = 0.0, frontdoor: bool = True) -> dict:
     from lightgbm_tpu.serving import ServingService
 
     def say(msg):
@@ -261,6 +574,14 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
                                     clients, max(closed_secs, 1.0))
         say(f"hot swap: {swap}")
 
+        # -- front-door socket legs (fresh services on ephemeral ports;
+        # the main svc and its ledger/tracer stay untouched) ---------------
+        fd = {}
+        if frontdoor:
+            fd = _frontdoor_legs(texts, v2_text, reqs, rows_per_req,
+                                 clients, closed_secs, qps_list, wait_ms,
+                                 max_batch_rows, say)
+
         p50d, p99d = _percentiles(lat_dir)
         p50c, p99c = _percentiles(lat_co)
         stats = svc.stats()
@@ -271,7 +592,7 @@ def run(models: int = 2, rows_per_req: int = 16, qps_list=(50, 200, 800),
             # below is then a no-op)
             svc.coalescer.close()
             trace_rec["serve_trace"] = svc.tracer.totals()
-        return dict(trace_rec, **{
+        return dict(trace_rec, **fd, **{
             "serve_models": models,
             "serve_rows_per_req": rows_per_req,
             "serve_clients": clients,
